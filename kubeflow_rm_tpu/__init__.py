@@ -1,0 +1,20 @@
+"""kubeflow_rm_tpu — a TPU-native rebuild of the Kubeflow Notebooks stack.
+
+Two halves, mirroring the layer map in SURVEY.md §1:
+
+- ``controlplane``: the platform — Notebook/Profile/PodDefault/Tensorboard/
+  PVCViewer resource model, reconcilers that render TPU-slice StatefulSets,
+  the mutating-webhook merge engine with TPU rendezvous injection, per-
+  namespace TPU-chip quotas, culling, and the web-app backends.
+  (Capability parity with /root/reference components/*, re-designed for
+  slice-atomic TPU scheduling; citations in each module's docstring.)
+
+- the compute path (``models``, ``ops``, ``parallel``, ``training``): what
+  runs *inside* the provisioned notebook image — a JAX/pallas Llama stack
+  with FSDP/TP/SP sharding over a ``jax.sharding.Mesh``, ring attention for
+  long context, and a fine-tuning trainer targeting >=40% MFU (BASELINE.md).
+  The reference delegates this layer to CUDA wheels inside its images
+  (SURVEY.md §2.6); here it is first-class.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,213 @@
+"""Llama-family decoder, written TPU-first in functional JAX.
+
+Design notes (why this is not a torch translation):
+
+- **Scan over layers.** All transformer blocks share one set of stacked
+  weights with a leading layer axis and run under ``lax.scan``. XLA
+  compiles a single block once instead of unrolling n_layers copies —
+  compile time stays flat as depth grows, and the stacked layout gives
+  every layer identical sharding, which is what the FSDP all-gather
+  schedule wants.
+
+- **Rematerialization.** ``jax.checkpoint`` wraps the scanned block with
+  a dots-saveable policy: matmul outputs survive, attention scores and
+  softmax are recomputed in the backward pass. This trades a ~30% FLOP
+  overhead in attention for O(1) live layers of activation memory — the
+  standard HBM/FLOPs trade on TPU.
+
+- **bf16 compute, fp32 params/master.** Params are stored in
+  ``param_dtype`` (fp32 by default) and cast to ``dtype`` (bf16) at use;
+  the final logits come back in fp32 for the loss.
+
+- Weights use a GPT-2-style scaled init (out-projections scaled by
+  1/sqrt(2 * n_layers)) so tiny test configs train stably.
+
+This model is the flagship for the jupyter-jax notebook image; the
+platform half of the repo provisions the slice it runs on
+(BASELINE.json north_star).
+"""
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_rm_tpu.ops import (
+    apply_rope,
+    dot_product_attention,
+    rms_norm,
+    rope_angles,
+)
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    hidden_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # ---- presets -----------------------------------------------------
+    @staticmethod
+    def llama2_7b(**overrides) -> "LlamaConfig":
+        return replace(LlamaConfig(), **overrides)
+
+    @staticmethod
+    def llama2_13b(**overrides) -> "LlamaConfig":
+        return replace(
+            LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                        hidden_dim=13824),
+            **overrides,
+        )
+
+    @staticmethod
+    def llama3_8b(**overrides) -> "LlamaConfig":
+        return replace(
+            LlamaConfig(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                        n_kv_heads=8, hidden_dim=14336, rope_theta=500000.0,
+                        max_seq_len=8192),
+            **overrides,
+        )
+
+    @staticmethod
+    def bench_1b(**overrides) -> "LlamaConfig":
+        """~1.2B-param config sized for a single v5e chip (16 GiB HBM)."""
+        return replace(
+            LlamaConfig(dim=2048, n_layers=20, n_heads=16, n_kv_heads=16,
+                        hidden_dim=5632, max_seq_len=2048),
+            **overrides,
+        )
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """Test-sized config: runs in milliseconds on a CPU mesh."""
+        return replace(
+            LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, hidden_dim=128, max_seq_len=128,
+                        dtype=jnp.float32),
+            **overrides,
+        )
+
+
+def param_spec_shapes(cfg: LlamaConfig) -> dict:
+    """Abstract shapes of the parameter pytree (layer-stacked)."""
+    L, D, V = cfg.n_layers, cfg.dim, cfg.vocab_size
+    H, KVH, hd, F = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.hidden_dim
+    return {
+        "embed": {"tokens": (V, D)},
+        "blocks": {
+            "attn_norm": (L, D),
+            "wq": (L, D, H * hd),
+            "wk": (L, D, KVH * hd),
+            "wv": (L, D, KVH * hd),
+            "wo": (L, H * hd, D),
+            "mlp_norm": (L, D),
+            "w_gate": (L, D, F),
+            "w_up": (L, D, F),
+            "w_down": (L, F, D),
+        },
+        "out_norm": (D,),
+        "lm_head": (D, V),
+    }
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Random-init a parameter pytree matching ``param_spec_shapes``."""
+    shapes = param_spec_shapes(cfg)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        shapes, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    keys = jax.random.split(key, len(flat))
+    out_scale = 0.02 / (2.0 * cfg.n_layers) ** 0.5
+
+    def init_one(path, shape, k):
+        name = path[-1].key
+        if "norm" in name:
+            return jnp.ones(shape, cfg.param_dtype)
+        if name in ("wo", "w_down"):  # residual-writing projections
+            return (jax.random.normal(k, shape) * out_scale).astype(cfg.param_dtype)
+        return (jax.random.normal(k, shape) * 0.02).astype(cfg.param_dtype)
+
+    leaves = [init_one(p, s, k) for (p, s), k in zip(flat, keys)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
+    """One transformer block. x: (B, T, D) in compute dtype."""
+    B, T, D = x.shape
+    H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.dtype
+
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(cdt)).reshape(B, T, H, hd)
+    k = (h @ layer["wk"].astype(cdt)).reshape(B, T, KVH, hd)
+    v = (h @ layer["wv"].astype(cdt)).reshape(B, T, KVH, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = dot_product_attention(
+        q, k, v, causal=True, positions_q=positions, positions_kv=positions
+    )
+    x = x + attn.reshape(B, T, H * hd) @ layer["wo"].astype(cdt)
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = h @ layer["w_gate"].astype(cdt)
+    up = h @ layer["w_up"].astype(cdt)
+    x = x + (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cdt)
+    return x
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    positions: jax.Array | None = None,
+) -> jax.Array:
+    """Causal LM forward pass.
+
+    Args:
+      params: pytree from ``init_params``.
+      tokens: (B, T) int32 token ids.
+      positions: (B, T) global positions; defaults to arange. Passing
+        explicit positions is how sequence-parallel shards and packed
+        sequences get correct RoPE and causal masking.
+
+    Returns:
+      (B, T, vocab) fp32 logits.
+    """
+    B, T = tokens.shape
+    cdt = cfg.dtype
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    x = params["embed"]["tokens"].astype(cdt)[tokens]
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        )
+
+    def scan_body(x, layer):
+        return block(x, layer, cos, sin, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+
+    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(cdt)
+    return logits.astype(jnp.float32)

@@ -1,0 +1,12 @@
+from kubeflow_rm_tpu.ops.norms import rms_norm
+from kubeflow_rm_tpu.ops.rope import apply_rope, rope_angles
+from kubeflow_rm_tpu.ops.attention import dot_product_attention
+from kubeflow_rm_tpu.ops.losses import softmax_cross_entropy
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_angles",
+    "dot_product_attention",
+    "softmax_cross_entropy",
+]

@@ -1,0 +1,56 @@
+"""Training losses.
+
+The cross-entropy is computed from logits in fp32 with an optional z-loss
+regularizer (keeps the softmax normalizer bounded — standard practice for
+bf16 TPU training). Labels set to ``ignore_index`` contribute zero loss
+and zero weight, which is how the data pipeline masks padding and prompt
+tokens during fine-tuning.
+"""
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    z_loss: float = 0.0,
+    ignore_index: int = IGNORE_INDEX,
+):
+    """Mean token cross-entropy.
+
+    Args:
+      logits: (..., V) unnormalized log-probs (any float dtype; promoted
+        to fp32 internally).
+      labels: (...) int targets, with ``ignore_index`` marking tokens to
+        exclude from the mean.
+
+    Returns:
+      (loss, aux) where ``loss`` is the scalar masked mean NLL
+      (+ z-loss if requested) and ``aux`` has per-component terms and the
+      valid-token count.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(
+        logits, safe_labels[..., None], axis=-1
+    ).squeeze(-1)
+    nll = lse - label_logit
+
+    weight = valid.astype(jnp.float32)
+    denom = jnp.maximum(weight.sum(), 1.0)
+    nll_mean = (nll * weight).sum() / denom
+
+    aux = {"nll": nll_mean, "n_valid": weight.sum()}
+    loss = nll_mean
+    if z_loss:
+        zl = z_loss * ((lse**2) * weight).sum() / denom
+        aux["z_loss"] = zl
+        loss = loss + zl
+    return loss, aux

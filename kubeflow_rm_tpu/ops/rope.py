@@ -1,0 +1,42 @@
+"""Rotary position embeddings (RoPE).
+
+Angles are computed inside the jitted computation from integer positions
+rather than gathered from a precomputed table: on TPU the trig is a few
+cheap VPU ops that XLA fuses into the surrounding reshapes, it keeps the
+op shape-polymorphic in sequence length, and — critically for sequence
+parallelism — each shard can evaluate its *global* positions locally with
+no gather and no replicated (max_seq, head_dim) buffer in HBM.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0):
+    """Return (cos, sin) of shape ``positions.shape + (head_dim // 2,)``.
+
+    ``positions`` is an integer array of token positions (any shape,
+    typically (B, T)); fractional frequencies follow the Llama convention
+    ``theta ** (-2i/d)``.
+    """
+    half = head_dim // 2
+    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half * 1.0)
+    # positions: (..., 1) * freq: (half,) -> (..., half)
+    ang = positions.astype(jnp.float32)[..., None] * freq
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate ``x`` of shape (B, T, H, head_dim) by per-position angles.
+
+    ``cos``/``sin`` have shape (B, T, head_dim//2) and broadcast over the
+    head axis. Uses the split-halves convention (first half paired with
+    second half), matching the neox/llama JAX implementations.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    c = cos[..., None, :]  # (B, T, 1, half)
+    s = sin[..., None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

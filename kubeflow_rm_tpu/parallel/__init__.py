@@ -1,0 +1,16 @@
+from kubeflow_rm_tpu.parallel.mesh import MeshConfig, make_mesh
+from kubeflow_rm_tpu.parallel.sharding import (
+    batch_pspec,
+    param_pspecs,
+    param_shardings,
+)
+from kubeflow_rm_tpu.parallel.ring_attention import ring_attention
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "batch_pspec",
+    "param_pspecs",
+    "param_shardings",
+    "ring_attention",
+]

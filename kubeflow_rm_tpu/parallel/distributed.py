@@ -1,0 +1,62 @@
+"""Multi-host bootstrap from platform-injected env.
+
+This is the in-image consumer of the control plane's rendezvous contract:
+the notebook webhook injects ``TPU_WORKER_ID`` (pod ordinal) and
+``TPU_WORKER_HOSTNAMES`` (headless-service DNS of every pod in the
+slice) into each pod of a multi-host Notebook
+(controlplane/webhook/tpu_inject.py). The reference has no equivalent —
+its servers are single-pod (SURVEY.md §2.6, notebook_controller.go:409-412
+replicas in {0,1}) — so this module plus the webhook is new capability.
+"""
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class TpuEnv:
+    worker_id: int
+    worker_hostnames: list[str]
+    accelerator_type: str | None
+    topology: str | None
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, len(self.worker_hostnames))
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_hosts > 1
+
+
+def tpu_env(environ=None) -> TpuEnv:
+    """Read the rendezvous env injected by the notebook webhook."""
+    env = os.environ if environ is None else environ
+    hostnames = [
+        h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    ]
+    return TpuEnv(
+        worker_id=int(env.get("TPU_WORKER_ID", "0")),
+        worker_hostnames=hostnames,
+        accelerator_type=env.get("TPU_ACCELERATOR_TYPE"),
+        topology=env.get("TPU_TOPOLOGY"),
+    )
+
+
+def initialize(environ=None, port: int = DEFAULT_COORDINATOR_PORT) -> TpuEnv:
+    """Initialize ``jax.distributed`` from the injected env (no-op on
+    single-host slices). Worker 0's headless DNS name is the coordinator —
+    pod ordinals are stable because the controller renders the slice as a
+    StatefulSet with a headless service."""
+    env = tpu_env(environ)
+    if env.is_multihost:
+        jax.distributed.initialize(
+            coordinator_address=f"{env.worker_hostnames[0]}:{port}",
+            num_processes=env.num_hosts,
+            process_id=env.worker_id,
+        )
+    return env

@@ -1,0 +1,72 @@
+"""Device-mesh construction.
+
+Axis convention used throughout the framework:
+
+- ``dp``   pure data parallelism (params replicated) — maps to DCN
+           across slices in multi-slice jobs.
+- ``fsdp`` data parallelism with parameter sharding (ZeRO-3 style);
+           rides ICI within a slice so the per-layer all-gathers are
+           cheap.
+- ``sp``   sequence/context parallelism (ring attention) — also ICI.
+- ``tp``   tensor (megatron-style) parallelism — innermost axis so its
+           per-matmul collectives take the fastest ICI hops.
+
+Axis order in the mesh tuple is outermost-to-innermost exactly as above:
+``jax.make_mesh`` assigns the innermost mesh axis to the most-local
+device neighbourhoods, which is where tp's latency-sensitive
+all-reduces belong.
+
+The platform half of this repo guarantees the env this module consumes:
+the webhook injects TPU_WORKER_ID/TPU_WORKER_HOSTNAMES (SURVEY.md §2.6)
+and the controller renders the slice topology into the pod.
+"""
+
+from dataclasses import dataclass
+
+import jax
+from jax.sharding import AxisType, Mesh
+
+
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = -1  # -1: absorb all remaining devices
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        sizes = [self.dp, self.fsdp, self.sp, self.tp]
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        n_wild = sizes.count(-1)
+        if n_wild > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if n_wild == 1:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {known}"
+                )
+            sizes[sizes.index(-1)] = n_devices // known
+        if sizes[0] * sizes[1] * sizes[2] * sizes[3] != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXES, sizes))} does not cover {n_devices} devices"
+            )
+        return tuple(sizes)
+
+
+def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build the framework-standard 4-axis mesh over ``devices``."""
+    config = config or MeshConfig()
+    devices = devices if devices is not None else jax.devices()
+    shape = config.resolve(len(devices))
+    # Auto axis types: shardings are annotations and XLA's SPMD
+    # partitioner propagates + inserts collectives (GSPMD), rather than
+    # jax 0.9's default Explicit sharding-in-types mode.
+    return jax.make_mesh(
+        shape, AXES, devices=devices, axis_types=(AxisType.Auto,) * len(AXES)
+    )

@@ -1,0 +1,129 @@
+"""Ring attention: sequence-parallel exact attention over the ``sp`` axis.
+
+Each device holds a contiguous sequence chunk of Q, K and V. K/V chunks
+rotate around the ring with ``lax.ppermute`` (one ICI hop per step) while
+every device folds the visiting chunk into a running flash-style online
+softmax (m, l, o accumulators in fp32). After ``sp`` steps every query
+has attended to every key exactly once — memory stays O(T/sp) per device
+and the per-step compute (a (Tloc x Tloc) block) overlaps with the next
+chunk's transfer.
+
+Causality is enforced with *global* positions, so the math is exact for
+any contiguous sharding; fully-future chunks still rotate through (the
+ring schedule is uniform) but their scores are masked. The per-step body
+is wrapped in ``jax.checkpoint`` so the backward pass recomputes block
+scores instead of saving n_steps score tensors.
+
+The reference platform has no long-context story at all (SURVEY.md §5
+"Long-context / sequence parallelism: absent") — this module is the
+TPU-native capability that fills it, and the notebook webhook's
+TPU_WORKER_* injection provides the multi-host mesh it runs on.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_rm_tpu.ops.attention import NEG_INF
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    positions_q: jax.Array | None = None,
+    positions_kv: jax.Array | None = None,
+) -> jax.Array:
+    """Attention over sequence shards. Call inside ``shard_map``.
+
+    Args:
+      q: (B, Tloc, H, D) local query chunk.
+      k, v: (B, Tloc, KVH, D) local key/value chunks.
+      positions_q / positions_kv: (B, Tloc) global positions of the local
+        chunk; default assumes contiguous equal chunks in ring order.
+
+    Returns:
+      (B, Tloc, H, D) local attention output in q.dtype.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    _, Tk, KVH, _ = k.shape
+    assert H % KVH == 0
+    G = H // KVH
+    scale = D ** -0.5
+
+    if positions_q is None:
+        positions_q = my * Tq + jnp.arange(Tq, dtype=jnp.int32)
+        positions_q = jnp.broadcast_to(positions_q, (B, Tq))
+
+    qf = (q.astype(jnp.float32) * scale).reshape(B, Tq, KVH, G, D)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        o, m, l, kc, vc, pos_kc = carry
+        s = jnp.einsum(
+            "bqkgd,bskd->bkgqs", qf, kc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )  # (B, KVH, G, Tq, Tk)
+        if causal:
+            mask = positions_q[:, :, None] >= pos_kc[:, None, :]  # (B, Tq, Tk)
+            s = jnp.where(mask[:, None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum(
+            "bkgqs,bskd->bkgqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        o_new = o * corr[..., None] + pv
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        pos_kc = jax.lax.ppermute(pos_kc, axis_name, perm)
+        return (o_new, m_new, l_new, kc, vc, pos_kc), None
+
+    if positions_kv is None:
+        positions_kv = my * Tk + jnp.arange(Tk, dtype=jnp.int32)
+        positions_kv = jnp.broadcast_to(positions_kv, (B, Tk))
+
+    # initial accumulators are constants — mark them varying over the ring
+    # axis so the scan carry type matches its (shard-varying) outputs
+    def varying(x):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+    o0 = varying(jnp.zeros((B, KVH, G, Tq, D), jnp.float32))
+    m0 = varying(jnp.full((B, KVH, G, Tq), NEG_INF, jnp.float32))
+    l0 = varying(jnp.zeros((B, KVH, G, Tq), jnp.float32))
+
+    (o, m, l, _, _, _), _ = jax.lax.scan(
+        jax.checkpoint(step), (o0, m0, l0, k, v, positions_kv),
+        jnp.arange(n),
+    )
+    out = o / l[..., None]
+    # (B, KVH, G, Tq, D) -> (B, Tq, H, D)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, Tq, H, D)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True):
+    """Global-view convenience wrapper: shard_map over the ``sp`` axis only.
+
+    Inputs are global (B, T, H, D) arrays laid out on ``mesh``; batch and
+    head axes stay under automatic (GSPMD) partitioning.
+    """
+    spec = P(None, "sp", None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={"sp"},
+    )
+    return fn(q, k, v)

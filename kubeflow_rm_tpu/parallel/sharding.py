@@ -1,0 +1,68 @@
+"""Sharding rules: parameter pytree -> PartitionSpec pytree.
+
+The recipe (scaling-book style): annotate shardings on params and batch,
+jit the step, and let XLA's SPMD partitioner insert the collectives.
+
+Rules for the layer-stacked Llama pytree (leading axis = layer, never
+sharded):
+
+- column-parallel weights (wq/wk/wv/w_gate/w_up): contract dim sharded
+  on ``fsdp``, output dim on ``tp`` — forward needs an fsdp all-gather
+  of the weight (prefetched by XLA) and no activation collective.
+- row-parallel weights (wo/w_down): ``tp`` on the contracting dim, so
+  each tp shard computes a partial product and XLA inserts the single
+  psum per block that megatron TP requires.
+- embed: vocab on ``tp``, model dim on ``fsdp``; lm_head transposed
+  likewise. norms replicated.
+
+The batch is sharded over (dp, fsdp) jointly — fsdp is a data-parallel
+axis from the batch's point of view — and over ``sp`` along sequence.
+"""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# path (joined with '/') -> spec for the stacked-layer llama pytree
+_LLAMA_RULES = {
+    "embed/tokens": P("tp", "fsdp"),
+    "blocks/attn_norm": P(None, None),
+    "blocks/mlp_norm": P(None, None),
+    "blocks/wq": P(None, "fsdp", "tp"),
+    "blocks/wk": P(None, "fsdp", "tp"),
+    "blocks/wv": P(None, "fsdp", "tp"),
+    "blocks/wo": P(None, "tp", "fsdp"),
+    "blocks/w_gate": P(None, "fsdp", "tp"),
+    "blocks/w_up": P(None, "fsdp", "tp"),
+    "blocks/w_down": P(None, "tp", "fsdp"),
+    "out_norm": P(None),
+    "lm_head": P("fsdp", "tp"),
+}
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        p.key if hasattr(p, "key") else str(p.idx) for p in path
+    )
+
+
+def param_pspecs(params) -> dict:
+    """PartitionSpec pytree for a Llama param pytree (or matching shapes)."""
+
+    def spec_for(path, leaf):
+        key = _path_str(path)
+        if key not in _LLAMA_RULES:
+            raise KeyError(f"no sharding rule for param {key!r}")
+        return _LLAMA_RULES[key]
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def param_shardings(params, mesh: Mesh) -> dict:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), param_pspecs(params)
+    )
+
+
+def batch_pspec(sequence_sharded: bool = True) -> P:
+    """Spec for (B, T) token batches: batch over dp+fsdp, seq over sp."""
+    return P(("dp", "fsdp"), "sp" if sequence_sharded else None)

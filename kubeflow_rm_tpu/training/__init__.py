@@ -1,0 +1,8 @@
+from kubeflow_rm_tpu.training.train import (
+    TrainConfig,
+    TrainState,
+    init_train_state,
+    make_train_step,
+)
+
+__all__ = ["TrainConfig", "TrainState", "init_train_state", "make_train_step"]

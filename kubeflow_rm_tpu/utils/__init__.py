@@ -1,0 +1,7 @@
+from kubeflow_rm_tpu.utils.pytree import (
+    param_count,
+    tree_cast,
+    tree_size_bytes,
+)
+
+__all__ = ["param_count", "tree_cast", "tree_size_bytes"]

@@ -1,0 +1,91 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_rm_tpu.models import LlamaConfig, forward, init_params
+from kubeflow_rm_tpu.utils import param_count
+
+
+def test_param_count_7b_preset():
+    cfg = LlamaConfig.llama2_7b()
+    # exact llama-2-7b parameter count
+    D, L, F, V = cfg.dim, cfg.n_layers, cfg.hidden_dim, cfg.vocab_size
+    expected = (
+        V * D  # embed
+        + L * (2 * D + 4 * D * D + 3 * D * F)  # blocks (norms + attn + mlp)
+        + D  # out norm
+        + D * V  # lm head
+    )
+    shapes = __import__(
+        "kubeflow_rm_tpu.models.llama", fromlist=["param_spec_shapes"]
+    ).param_spec_shapes(cfg)
+    got = sum(
+        int(np.prod(s))
+        for s in jax.tree_util.tree_leaves(
+            shapes, is_leaf=lambda x: isinstance(x, tuple)
+        )
+    )
+    assert got == expected
+    assert got == 6_738_415_616  # published llama-2-7b size
+
+
+def test_forward_shapes_and_dtype():
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_forward_causality():
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    t1 = jax.random.randint(jax.random.key(1), (1, 12), 0, cfg.vocab_size)
+    t2 = t1.at[:, -1].set((t1[:, -1] + 1) % cfg.vocab_size)
+    l1 = forward(params, t1, cfg)
+    l2 = forward(params, t2, cfg)
+    np.testing.assert_allclose(
+        np.asarray(l1[:, :-1]), np.asarray(l2[:, :-1]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(l1[:, -1]), np.asarray(l2[:, -1]))
+
+
+def test_forward_remat_matches_no_remat():
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+    from dataclasses import replace
+
+    l_remat = forward(params, tokens, cfg)
+    l_plain = forward(params, tokens, replace(cfg, remat=False))
+    np.testing.assert_allclose(
+        np.asarray(l_remat), np.asarray(l_plain), atol=1e-5
+    )
+
+
+def test_forward_jit_and_grad():
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+
+    @jax.jit
+    def loss(p):
+        lg = forward(p, tokens, cfg)
+        return jnp.mean(lg**2)
+
+    g = jax.grad(loss)(params)
+    finite = jax.tree_util.tree_map(
+        lambda x: bool(np.all(np.isfinite(np.asarray(x)))), g
+    )
+    assert all(jax.tree_util.tree_leaves(finite))
+
+
+def test_gqa_config_runs():
+    cfg = LlamaConfig.tiny()  # tiny already has n_kv_heads=2 < n_heads=4
+    assert cfg.n_kv_heads < cfg.n_heads
+    params = init_params(cfg, jax.random.key(0))
+    logits = forward(params, jnp.zeros((1, 4), jnp.int32), cfg)
+    assert logits.shape == (1, 4, cfg.vocab_size)

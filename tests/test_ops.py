@@ -1,0 +1,144 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_rm_tpu.ops import (
+    apply_rope,
+    dot_product_attention,
+    rms_norm,
+    rope_angles,
+    softmax_cross_entropy,
+)
+from kubeflow_rm_tpu.ops.losses import IGNORE_INDEX
+
+
+def test_rms_norm_matches_reference():
+    x = jax.random.normal(jax.random.key(0), (2, 5, 16))
+    w = jax.random.normal(jax.random.key(1), (16,)) * 0.1 + 1.0
+    got = rms_norm(x, w)
+    ref = x / np.sqrt(np.mean(np.square(np.asarray(x)), -1, keepdims=True) + 1e-5)
+    ref = ref * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)
+
+
+def test_rms_norm_preserves_dtype():
+    x = jnp.ones((2, 4, 8), jnp.bfloat16)
+    assert rms_norm(x, jnp.ones((8,))).dtype == jnp.bfloat16
+
+
+def test_rope_rotation_preserves_norm_and_relative_angle():
+    B, T, H, D = 1, 6, 2, 8
+    q = jax.random.normal(jax.random.key(0), (B, T, H, D))
+    pos = jnp.broadcast_to(jnp.arange(T), (B, T))
+    cos, sin = rope_angles(pos, D)
+    q_rot = apply_rope(q, cos, sin)
+    # rotation preserves norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(q_rot), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is the identity
+    np.testing.assert_allclose(
+        np.asarray(q_rot[:, 0]), np.asarray(q[:, 0]), rtol=1e-5
+    )
+
+
+def test_rope_relative_property():
+    # <rot(q,i), rot(k,j)> depends only on i-j
+    D = 16
+    q = jax.random.normal(jax.random.key(0), (1, 1, 1, D))
+    k = jax.random.normal(jax.random.key(1), (1, 1, 1, D))
+
+    def dot_at(i, j):
+        pos_i = jnp.full((1, 1), i)
+        pos_j = jnp.full((1, 1), j)
+        qi = apply_rope(q, *rope_angles(pos_i, D))
+        kj = apply_rope(k, *rope_angles(pos_j, D))
+        return float(jnp.sum(qi * kj))
+
+    assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-5)
+
+
+def test_attention_causal_masking():
+    B, T, H, D = 2, 8, 2, 4
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(k1, (B, T, H, D))
+    k = jax.random.normal(k2, (B, T, H, D))
+    v = jax.random.normal(k3, (B, T, H, D))
+    out = dot_product_attention(q, k, v, causal=True)
+    # perturbing future keys/values must not change earlier outputs
+    k_mod = k.at[:, -1].set(99.0)
+    v_mod = v.at[:, -1].set(99.0)
+    out_mod = dot_product_attention(q, k_mod, v_mod, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out[:, :-1]), np.asarray(out_mod[:, :-1]), rtol=1e-5
+    )
+
+
+def test_attention_gqa_matches_repeated_kv():
+    B, T, H, KVH, D = 1, 6, 4, 2, 8
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, KVH, D))
+    v = jax.random.normal(ks[2], (B, T, KVH, D))
+    got = dot_product_attention(q, k, v, causal=True)
+    # reference: repeat kv heads to full H
+    k_rep = jnp.repeat(k, H // KVH, axis=2)
+    v_rep = jnp.repeat(v, H // KVH, axis=2)
+    # with repeated kv, group reshape ordering: head h uses kv head h//G
+    ref = dot_product_attention(q, k_rep, v_rep, causal=True)
+    # note: our grouping maps head (kvh*G+g) -> kv head kvh, same as repeat
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4)
+
+
+def test_attention_packed_positions_block_cross_document():
+    # two packed docs: positions restart; doc2 queries must ignore doc1 keys
+    B, T, H, D = 1, 8, 1, 4
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, H, D))
+    v = jax.random.normal(ks[2], (B, T, H, D))
+    pos = jnp.array([[0, 1, 2, 3, 0, 1, 2, 3]])
+    out = dot_product_attention(q, k, v, causal=True,
+                                positions_q=pos, positions_kv=pos)
+    # NOTE: positions-based mask alone allows doc2 q to see doc1 k at equal/lower
+    # positions — full packing isolation needs segment ids; here we assert
+    # pos-mask semantics: token 4 (pos 0) sees keys with pos<=0 i.e. {0, 4}.
+    s = jnp.einsum("bqhd,bshd->bhqs", q * D**-0.5, k)
+    allowed = np.asarray(pos[0])[:, None] >= np.asarray(pos[0])[None, :]
+    probs = np.asarray(jax.nn.softmax(jnp.where(allowed, s, -2.0**30), -1))
+    ref = np.einsum("bhqs,bshd->bqhd", probs, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4)
+
+
+def test_cross_entropy_uniform_logits():
+    V = 11
+    logits = jnp.zeros((2, 3, V))
+    labels = jnp.ones((2, 3), jnp.int32)
+    loss, aux = softmax_cross_entropy(logits, labels)
+    assert float(loss) == pytest.approx(np.log(V), rel=1e-5)
+    assert float(aux["n_valid"]) == 6
+
+
+def test_cross_entropy_ignore_index():
+    V = 7
+    logits = jax.random.normal(jax.random.key(0), (1, 4, V))
+    labels = jnp.array([[1, 2, IGNORE_INDEX, IGNORE_INDEX]])
+    loss, aux = softmax_cross_entropy(logits, labels)
+    assert float(aux["n_valid"]) == 2
+    # fully ignored -> zero loss, no NaN
+    loss0, aux0 = softmax_cross_entropy(
+        logits, jnp.full((1, 4), IGNORE_INDEX))
+    assert float(loss0) == 0.0
+    assert float(aux0["n_valid"]) == 0.0
+
+
+def test_cross_entropy_gradient_finite():
+    V = 7
+    logits = jax.random.normal(jax.random.key(0), (2, 3, V)) * 30
+    labels = jnp.zeros((2, 3), jnp.int32)
+    g = jax.grad(lambda l: softmax_cross_entropy(l, labels, z_loss=1e-4)[0])(
+        logits)
+    assert np.all(np.isfinite(np.asarray(g)))

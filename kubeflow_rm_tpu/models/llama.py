@@ -146,7 +146,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
+def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
     """One transformer block. x: (B, T, D) in compute dtype."""
     B, T, D = x.shape
     H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -159,7 +159,8 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn = dot_product_attention(
-        q, k, v, causal=True, positions_q=positions, positions_kv=positions
+        q, k, v, causal=True, positions_q=positions, positions_kv=positions,
+        segment_ids_q=segments, segment_ids_kv=segments,
     )
     x = x + attn.reshape(B, T, H * hd) @ layer["wo"].astype(cdt)
 
@@ -175,6 +176,7 @@ def forward(
     tokens: jax.Array,
     cfg: LlamaConfig,
     positions: jax.Array | None = None,
+    segments: jax.Array | None = None,
 ) -> jax.Array:
     """Causal LM forward pass.
 
@@ -183,7 +185,10 @@ def forward(
       tokens: (B, T) int32 token ids.
       positions: (B, T) global positions; defaults to arange. Passing
         explicit positions is how sequence-parallel shards and packed
-        sequences get correct RoPE and causal masking.
+        sequences get correct RoPE.
+      segments: (B, T) document segment ids for packed sequences (from
+        ``training.data.pack_documents``); restricts attention to equal
+        segments so packed documents stay independent.
 
     Returns:
       (B, T, vocab) fp32 logits.
@@ -193,7 +198,9 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
-    x = params["embed"]["tokens"].astype(cdt)[tokens]
+    # gather the (B, T, D) rows first, then cast — never materialize a
+    # compute-dtype copy of the whole (V, D) table
+    x = params["embed"]["tokens"][tokens].astype(cdt)
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
 
     block = partial(_block, cfg)
@@ -204,7 +211,7 @@ def forward(
         )
 
     def scan_body(x, layer):
-        return block(x, layer, cos, sin, positions), None
+        return block(x, layer, cos, sin, positions, segments), None
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
 

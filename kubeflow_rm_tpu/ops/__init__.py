@@ -1,12 +1,13 @@
 from kubeflow_rm_tpu.ops.norms import rms_norm
 from kubeflow_rm_tpu.ops.rope import apply_rope, rope_angles
-from kubeflow_rm_tpu.ops.attention import dot_product_attention
+from kubeflow_rm_tpu.ops.attention import attention_mask, dot_product_attention
 from kubeflow_rm_tpu.ops.losses import softmax_cross_entropy
 
 __all__ = [
     "rms_norm",
     "apply_rope",
     "rope_angles",
+    "attention_mask",
     "dot_product_attention",
     "softmax_cross_entropy",
 ]

@@ -23,6 +23,35 @@ import jax.numpy as jnp
 NEG_INF = -2.0**30  # large-but-finite: keeps fp32 softmax NaN-free on fully masked rows
 
 
+def attention_mask(
+    Tq: int,
+    Tk: int,
+    *,
+    causal: bool = True,
+    positions_q: jax.Array | None = None,
+    positions_kv: jax.Array | None = None,
+    segment_ids_q: jax.Array | None = None,
+    segment_ids_kv: jax.Array | None = None,
+) -> jax.Array | None:
+    """Boolean keep-mask, (Tq, Tk) or (B, Tq, Tk), or None if unmasked.
+
+    Causality uses global positions when given (sequence-parallel shards,
+    packed sequences); segment ids — when given — additionally restrict
+    attention to ``seg_q == seg_kv`` so packed documents stay independent
+    and padding (its own segment) is never attended.
+    """
+    mask = None
+    if causal:
+        if positions_q is None:
+            mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]  # (Tq, Tk)
+        else:
+            mask = positions_q[:, :, None] >= positions_kv[:, None, :]  # (B, Tq, Tk)
+    if segment_ids_q is not None:
+        seg = segment_ids_q[:, :, None] == segment_ids_kv[:, None, :]  # (B, Tq, Tk)
+        mask = seg if mask is None else mask & seg
+    return mask
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -31,6 +60,8 @@ def dot_product_attention(
     causal: bool = True,
     positions_q: jax.Array | None = None,
     positions_kv: jax.Array | None = None,
+    segment_ids_q: jax.Array | None = None,
+    segment_ids_kv: jax.Array | None = None,
     bias: jax.Array | None = None,
 ) -> jax.Array:
     """Scaled dot-product attention.
@@ -42,6 +73,8 @@ def dot_product_attention(
         are given (sequence-parallel shards, packed sequences) the mask is
         ``pos_q >= pos_kv``; otherwise it is the standard lower-triangular
         mask over local indices.
+      segment_ids_q / segment_ids_kv: optional (B, T) int segment ids for
+        packed sequences; attention is restricted to equal segments.
       bias: optional additive bias broadcastable to (B, H, Tq, Tk).
 
     Returns:
@@ -59,16 +92,18 @@ def dot_product_attention(
     scores = jnp.einsum("bqkgd,bskd->bkgqs", qf, k, preferred_element_type=jnp.float32)
 
     if bias is not None:
+        bias = jnp.broadcast_to(bias, (B, H, Tq, Tk))
         scores = scores + bias.reshape(B, KVH, G, Tq, Tk).astype(jnp.float32)
 
-    if causal:
-        if positions_q is None:
-            pos_q = jnp.arange(Tq)[:, None]
-            pos_kv = jnp.arange(Tk)[None, :]
-            mask = pos_q >= pos_kv  # (Tq, Tk)
+    mask = attention_mask(
+        Tq, Tk, causal=causal,
+        positions_q=positions_q, positions_kv=positions_kv,
+        segment_ids_q=segment_ids_q, segment_ids_kv=segment_ids_kv,
+    )
+    if mask is not None:
+        if mask.ndim == 2:
             scores = jnp.where(mask[None, None, None], scores, NEG_INF)
         else:
-            mask = positions_q[:, :, None] >= positions_kv[:, None, :]  # (B, Tq, Tk)
             scores = jnp.where(mask[:, None, None], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from kubeflow_rm_tpu.ops.attention import NEG_INF
+from kubeflow_rm_tpu.ops.attention import NEG_INF, attention_mask
 
 
 def ring_attention(
@@ -38,6 +38,8 @@ def ring_attention(
     causal: bool = True,
     positions_q: jax.Array | None = None,
     positions_kv: jax.Array | None = None,
+    segment_ids_q: jax.Array | None = None,
+    segment_ids_kv: jax.Array | None = None,
 ) -> jax.Array:
     """Attention over sequence shards. Call inside ``shard_map``.
 
@@ -46,9 +48,16 @@ def ring_attention(
       k, v: (B, Tloc, KVH, D) local key/value chunks.
       positions_q / positions_kv: (B, Tloc) global positions of the local
         chunk; default assumes contiguous equal chunks in ring order.
+      segment_ids_q / segment_ids_kv: optional (B, Tloc) segment ids for
+        packed sequences; attention is restricted to equal segments.
 
     Returns:
       (B, Tloc, H, D) local attention output in q.dtype.
+
+    Masked probabilities are zeroed *explicitly* (not just via NEG_INF
+    scores): for a query row whose blocks so far are fully masked the
+    running max ``m`` still equals the finite NEG_INF sentinel, and
+    ``exp(s - m) = 1`` would silently attend to masked keys.
     """
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -65,18 +74,37 @@ def ring_attention(
     qf = (q.astype(jnp.float32) * scale).reshape(B, Tq, KVH, G, D)
 
     perm = [(j, (j + 1) % n) for j in range(n)]
+    have_segments = segment_ids_q is not None or segment_ids_kv is not None
+    if have_segments:
+        # both-or-one: self-attention callers naturally pass only _q
+        if segment_ids_q is None:
+            segment_ids_q = segment_ids_kv
+        if segment_ids_kv is None:
+            segment_ids_kv = segment_ids_q
 
     def step(carry, i):
-        o, m, l, kc, vc, pos_kc = carry
+        # seg_kc rides the ring only when segments are in play — the
+        # no-segments trace carries (and ppermutes) nothing extra
+        if have_segments:
+            o, m, l, kc, vc, pos_kc, seg_kc = carry
+        else:
+            o, m, l, kc, vc, pos_kc = carry
+            seg_kc = None
         s = jnp.einsum(
             "bqkgd,bskd->bkgqs", qf, kc.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )  # (B, KVH, G, Tq, Tk)
-        if causal:
-            mask = positions_q[:, :, None] >= pos_kc[:, None, :]  # (B, Tq, Tk)
+        mask = attention_mask(
+            Tq, Tk, causal=causal,
+            positions_q=positions_q, positions_kv=pos_kc,
+            segment_ids_q=segment_ids_q, segment_ids_kv=seg_kc,
+        )  # (B, Tq, Tk) keep-mask (positions_q is always set here)
+        if mask is not None:
             s = jnp.where(mask[:, None, None], s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[..., None])
+        if mask is not None:
+            p = jnp.where(mask[:, None, None], p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
         pv = jnp.einsum(
@@ -87,7 +115,11 @@ def ring_attention(
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         pos_kc = jax.lax.ppermute(pos_kc, axis_name, perm)
-        return (o_new, m_new, l_new, kc, vc, pos_kc), None
+        out = (o_new, m_new, l_new, kc, vc, pos_kc)
+        if have_segments:
+            seg_kc = jax.lax.ppermute(seg_kc, axis_name, perm)
+            out = out + (seg_kc,)
+        return out, None
 
     if positions_kv is None:
         positions_kv = my * Tk + jnp.arange(Tk, dtype=jnp.int32)
@@ -102,28 +134,57 @@ def ring_attention(
     m0 = varying(jnp.full((B, KVH, G, Tq), NEG_INF, jnp.float32))
     l0 = varying(jnp.zeros((B, KVH, G, Tq), jnp.float32))
 
-    (o, m, l, _, _, _), _ = jax.lax.scan(
-        jax.checkpoint(step), (o0, m0, l0, k, v, positions_kv),
-        jnp.arange(n),
-    )
-    out = o / l[..., None]
+    init = (o0, m0, l0, k, v, positions_kv)
+    if have_segments:
+        init = init + (segment_ids_kv,)
+    carry, _ = jax.lax.scan(jax.checkpoint(step), init, jnp.arange(n))
+    o, m, l = carry[0], carry[1], carry[2]
+    # guard l == 0 (a query with no visible keys anywhere): emit zeros
+    out = o / jnp.maximum(l, 1e-30)[..., None]
     # (B, KVH, G, Tq, D) -> (B, Tq, H, D)
     out = out.transpose(0, 3, 1, 2, 4).reshape(B, Tq, H, D)
     return out.astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True):
+def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                        positions: jax.Array | None = None,
+                        segments: jax.Array | None = None):
     """Global-view convenience wrapper: shard_map over the ``sp`` axis only.
 
     Inputs are global (B, T, H, D) arrays laid out on ``mesh``; batch and
-    head axes stay under automatic (GSPMD) partitioning.
+    head axes stay under automatic (GSPMD) partitioning. ``positions`` /
+    ``segments`` are optional global (B, T) arrays for packed sequences.
     """
     spec = P(None, "sp", None, None)
+    sspec = P(None, "sp")
+
+    if positions is None and segments is None:
+        fn = jax.shard_map(
+            partial(ring_attention, axis_name="sp", causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={"sp"},
+        )
+        return fn(q, k, v)
+
+    B, T = q.shape[:2]
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    if segments is None:
+        segments = jnp.zeros((B, T), jnp.int32)
+
+    def local(q, k, v, pos, seg):
+        return ring_attention(
+            q, k, v, axis_name="sp", causal=causal,
+            positions_q=pos, positions_kv=pos,
+            segment_ids_q=seg, segment_ids_kv=seg,
+        )
+
     fn = jax.shard_map(
-        partial(ring_attention, axis_name="sp", causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec, sspec, sspec),
         out_specs=spec,
         axis_names={"sp"},
     )
-    return fn(q, k, v)
+    return fn(q, k, v, positions, segments)

@@ -24,14 +24,21 @@ def synthetic_batches(batch_size: int, seq_len: int, vocab_size: int,
 
 def pack_documents(docs: list[list[int]], seq_len: int,
                    pad_id: int = 0) -> dict:
-    """Pack token lists into fixed-length rows with per-row positions.
+    """Pack token lists into fixed-length rows with positions + segments.
 
     Documents are concatenated greedily; each row carries ``positions``
-    restarting at 0 per document so RoPE and the positions-aware causal
-    mask in ``ops.attention`` keep packed documents independent.
+    restarting at 0 per document (correct RoPE) and ``segments`` — a
+    per-row document id starting at 1, with padding as segment 0 — which
+    the segment-aware mask in ``ops.attention`` ANDs into the causal mask
+    so packed documents are fully independent and pad tokens are never
+    attended. Positions alone are NOT sufficient: a later document's
+    positions restart at 0, which a position-only causal mask would read
+    as "in the past" of every other document.
     """
     rows, row, pos_rows, pos = [], [], [], []
     label_rows, labels = [], []
+    seg_rows, segs = [], []
+    next_seg = 1
     for doc in docs:
         i = 0
         while i < len(doc):
@@ -39,20 +46,25 @@ def pack_documents(docs: list[list[int]], seq_len: int,
             take = doc[i:i + space]
             row.extend(take)
             pos.extend(range(i, i + len(take)))
+            segs.extend([next_seg] * len(take))
             labels.extend(doc[i + 1:i + len(take) + 1])
             if len(labels) < len(row):
                 labels.append(IGNORE_INDEX)
             i += len(take)
             if len(row) == seq_len:
-                rows.append(row); pos_rows.append(pos); label_rows.append(labels)
-                row, pos, labels = [], [], []
+                rows.append(row); pos_rows.append(pos)
+                label_rows.append(labels); seg_rows.append(segs)
+                row, pos, labels, segs = [], [], [], []
+        next_seg += 1
     if row:
         n = seq_len - len(row)
         rows.append(row + [pad_id] * n)
         pos_rows.append(pos + list(range(n)))
         label_rows.append(labels + [IGNORE_INDEX] * n)
+        seg_rows.append(segs + [0] * n)  # pad = segment 0, attends nothing real
     return {
         "tokens": np.asarray(rows, np.int32).reshape(-1, seq_len),
         "labels": np.asarray(label_rows, np.int32).reshape(-1, seq_len),
         "positions": np.asarray(pos_rows, np.int32).reshape(-1, seq_len),
+        "segments": np.asarray(seg_rows, np.int32).reshape(-1, seq_len),
     }

@@ -78,7 +78,8 @@ def state_shardings(cfg: TrainConfig, state: TrainState, mesh: Mesh) -> TrainSta
 
 def loss_fn(params, batch, cfg: TrainConfig):
     logits = forward(params, batch["tokens"], cfg.model,
-                     positions=batch.get("positions"))
+                     positions=batch.get("positions"),
+                     segments=batch.get("segments"))
     return softmax_cross_entropy(logits, batch["labels"], z_loss=cfg.z_loss)
 
 
@@ -88,7 +89,8 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
 
     ``batch`` maps each of ``batch_keys`` to a (B, T) int32 array laid
     out with ``batch_pspec`` on ``mesh`` — "tokens" and "labels" always,
-    plus "positions" when training on packed documents.
+    plus "positions" and "segments" when training on packed documents
+    (see ``training.data.pack_documents``).
     """
     opt = make_optimizer(cfg.optim)
     sshard = state_shardings(cfg, state, mesh)

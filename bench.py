@@ -1,0 +1,109 @@
+"""Single-chip training benchmark — the driver contract.
+
+Runs a sharded Llama train step on whatever accelerator jax exposes
+(the one real TPU chip under axon; falls back to a tiny CPU config so
+the harness always produces a number) and prints ONE JSON line:
+
+    {"metric": "mfu", "value": <percent>, "unit": "%", "vs_baseline": <value/40>,
+     "tokens_per_sec": ..., "step_time_ms": ..., ...}
+
+vs_baseline is measured against the BASELINE.json north star of 40% MFU
+(the reference itself publishes no numbers — SURVEY.md §6).
+
+Timing discipline: batches stay device-resident (host→device transfers
+through the axon tunnel cost ~300 ms and are not what we're measuring),
+warmup covers compile + 2 steps, and the timed region blocks on the
+final step's metrics only.
+"""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_rm_tpu.models import LlamaConfig
+    from kubeflow_rm_tpu.parallel import MeshConfig, make_mesh
+    from kubeflow_rm_tpu.training.train import (
+        TrainConfig, init_train_state, make_train_step, shard_batch,
+    )
+    from kubeflow_rm_tpu.utils.flops import (
+        device_peak_flops, train_flops_per_token,
+    )
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        # ~1.2B params; bf16 params keep params+grads+adam under a v5e's
+        # 16 GiB HBM (fp32 master + moments would not fit)
+        model = LlamaConfig.bench_1b(param_dtype=jnp.bfloat16)
+        batch, steps, warmup = 4, 10, 2
+    else:
+        model = LlamaConfig.tiny()
+        batch, steps, warmup = 8, 6, 2
+    seq_len = model.max_seq_len if on_tpu else 128
+
+    cfg = TrainConfig(model=model)
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=1, tp=1),
+                     devices=devices[:1])
+
+    state = init_train_state(cfg, jax.random.key(0))
+    step = make_train_step(cfg, mesh, state)
+
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, model.vocab_size, (batch, seq_len), dtype=np.int32)
+    labels = np.roll(tok, -1, axis=1).astype(np.int32)
+    host_batch = {"tokens": tok, "labels": labels}
+    dev_batch = shard_batch(host_batch, mesh)  # device-resident once
+
+    for _ in range(warmup):
+        state, metrics = step(state, dev_batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, dev_batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    step_time = dt / steps
+    tokens_per_sec = batch * seq_len / step_time
+    flops_tok = train_flops_per_token(model, seq_len)
+    peak = device_peak_flops(devices[0])
+    achieved = tokens_per_sec * flops_tok
+
+    if peak:
+        mfu_pct = 100.0 * achieved / peak
+    else:
+        mfu_pct = 0.0  # unknown peak (CPU fallback): report throughput only
+
+    out = {
+        "metric": "mfu",
+        "value": round(mfu_pct, 2),
+        "unit": "%",
+        "vs_baseline": round(mfu_pct / 40.0, 4),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_time_ms": round(step_time * 1e3, 2),
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "device": getattr(devices[0], "device_kind", platform),
+        "model": "llama-bench1b" if on_tpu else "llama-tiny(cpu-fallback)",
+        "batch": batch,
+        "seq_len": seq_len,
+        "final_loss": round(float(metrics["loss"]), 4),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # the driver must always get a parseable line
+        print(json.dumps({"metric": "mfu", "value": 0.0, "unit": "%",
+                          "vs_baseline": 0.0, "error": repr(e)}))
+        sys.exit(0)

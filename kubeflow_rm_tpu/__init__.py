@@ -3,11 +3,13 @@
 Two halves, mirroring the layer map in SURVEY.md §1:
 
 - ``controlplane``: the platform — Notebook/Profile/PodDefault/Tensorboard/
-  PVCViewer resource model, reconcilers that render TPU-slice StatefulSets,
-  the mutating-webhook merge engine with TPU rendezvous injection, per-
-  namespace TPU-chip quotas, culling, and the web-app backends.
-  (Capability parity with /root/reference components/*, re-designed for
-  slice-atomic TPU scheduling; citations in each module's docstring.)
+  PVCViewer resource model (``controlplane/api``), reconcilers that render
+  TPU-slice StatefulSets (``controlplane/controllers``), the mutating-
+  webhook merge engine with TPU rendezvous injection
+  (``controlplane/webhook``), per-namespace TPU-chip quotas, and idle
+  culling. Capability parity
+  with /root/reference components/*, re-designed for slice-atomic TPU
+  scheduling; citations in each module's docstring.
 
 - the compute path (``models``, ``ops``, ``parallel``, ``training``): what
   runs *inside* the provisioned notebook image — a JAX/pallas Llama stack

@@ -1,0 +1,82 @@
+"""controlplane — the TPU-native Kubeflow Notebooks platform.
+
+The platform half of this repo (SURVEY.md §1 layers L2–L5): the
+Notebook/Profile/PodDefault/Tensorboard/PVCViewer resource model, the
+reconcilers that render TPU-slice StatefulSets, the mutating-webhook
+merge engine with TPU rendezvous injection, per-namespace TPU-chip
+quotas, idle culling, and the web-app backends. Runs against the
+in-memory apiserver for tests and against a real cluster through the
+same verb surface.
+
+``make_control_plane()`` assembles the full stack the way the
+reference's kustomize manifests assemble its deployments.
+"""
+
+from __future__ import annotations
+
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+
+
+def make_control_plane(clock=None, *, auto_ready: bool = True,
+                       enable_culling: bool = False,
+                       culler_config=None):
+    """Build (api, manager) with every controller and webhook wired.
+
+    ``clock`` is injectable for deterministic culling tests;
+    ``auto_ready=False`` leaves scheduled pods un-Ready for status tests.
+    """
+    from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+    from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
+    from kubeflow_rm_tpu.controlplane.controllers.culling import (
+        CullingController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.notebook import (
+        NotebookController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.profile import (
+        ProfileController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.pvcviewer import (
+        PVCViewerController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+        DeploymentController,
+        StatefulSetController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.tensorboard import (
+        TensorboardController,
+    )
+    from kubeflow_rm_tpu.controlplane.runtime import Manager
+    from kubeflow_rm_tpu.controlplane.webhook.notebook import (
+        LockReleaseController,
+        NotebookWebhook,
+    )
+    from kubeflow_rm_tpu.controlplane.webhook.poddefault import (
+        PodDefaultWebhook,
+    )
+    from kubeflow_rm_tpu.controlplane.webhook.tpu_inject import (
+        TpuInjectWebhook,
+    )
+
+    api = APIServer(**({"clock": clock} if clock else {}))
+    api.register_validator(nb_api.KIND, nb_api.validate)
+    api.register_validator(pd_api.KIND, pd_api.validate)
+
+    # admission order: notebook webhook on Notebooks; for pods, the
+    # PodDefault merge runs before TPU injection (injection must see the
+    # final container set, sidecars included)
+    NotebookWebhook(api).register()
+    PodDefaultWebhook(api).register()
+    TpuInjectWebhook(api).register()
+
+    manager = Manager(api)
+    manager.add(NotebookController())
+    manager.add(LockReleaseController())
+    manager.add(StatefulSetController(auto_ready=auto_ready))
+    manager.add(DeploymentController(auto_ready=auto_ready))
+    manager.add(ProfileController())
+    manager.add(TensorboardController())
+    manager.add(PVCViewerController())
+    if enable_culling:
+        manager.add(CullingController(**(culler_config or {})))
+    return api, manager

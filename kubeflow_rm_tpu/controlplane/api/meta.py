@@ -1,0 +1,206 @@
+"""Kubernetes-style object model: plain dicts + typed helpers.
+
+Objects are nested dicts shaped exactly like their Kubernetes wire form
+(``apiVersion``/``kind``/``metadata``/``spec``/``status``). The
+reference manipulates the same shapes through Go structs
+(e.g. ``components/notebook-controller/api/v1beta1/notebook_types.go:27-63``);
+here the dict IS the API object and these helpers give the handful of
+typed operations every controller needs (deep access, owner refs,
+label selection) without inventing a parallel corev1.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+from typing import Any
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter):08d}"
+
+
+def make_object(api_version: str, kind: str, name: str,
+                namespace: str | None = None, *,
+                labels: dict | None = None,
+                annotations: dict | None = None,
+                spec: Any = None) -> dict:
+    meta: dict[str, Any] = {"name": name}
+    if namespace is not None:
+        meta["namespace"] = namespace
+    if labels:
+        meta["labels"] = dict(labels)
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    obj = {"apiVersion": api_version, "kind": kind, "metadata": meta}
+    if spec is not None:
+        obj["spec"] = spec
+    return obj
+
+
+def name_of(obj: dict) -> str:
+    return obj["metadata"]["name"]
+
+
+def namespace_of(obj: dict) -> str | None:
+    return obj["metadata"].get("namespace")
+
+
+def uid_of(obj: dict) -> str | None:
+    return obj["metadata"].get("uid")
+
+
+def labels_of(obj: dict) -> dict:
+    return obj["metadata"].get("labels") or {}
+
+
+def annotations_of(obj: dict) -> dict:
+    return obj["metadata"].get("annotations") or {}
+
+
+def set_annotation(obj: dict, key: str, value: str) -> None:
+    obj["metadata"].setdefault("annotations", {})[key] = value
+
+
+def remove_annotation(obj: dict, key: str) -> None:
+    obj["metadata"].get("annotations", {}).pop(key, None)
+
+
+def set_label(obj: dict, key: str, value: str) -> None:
+    obj["metadata"].setdefault("labels", {})[key] = value
+
+
+def deep_get(obj: Any, *path, default=None):
+    cur = obj
+    for p in path:
+        if isinstance(cur, dict):
+            if p not in cur:
+                return default
+            cur = cur[p]
+        elif isinstance(cur, list):
+            if not isinstance(p, int) or p >= len(cur):
+                return default
+            cur = cur[p]
+        else:
+            return default
+    return cur
+
+
+def deep_set(obj: dict, *path_and_value) -> None:
+    *path, value = path_and_value
+    cur = obj
+    for p in path[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[path[-1]] = value
+
+
+def owner_reference(owner: dict, *, controller: bool = True,
+                    block_owner_deletion: bool = True) -> dict:
+    return {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": name_of(owner),
+        "uid": uid_of(owner),
+        "controller": controller,
+        "blockOwnerDeletion": block_owner_deletion,
+    }
+
+
+def set_controller_reference(owner: dict, obj: dict) -> None:
+    refs = obj["metadata"].setdefault("ownerReferences", [])
+    for r in refs:
+        if r.get("controller"):
+            if r.get("uid") != uid_of(owner):
+                raise ValueError(
+                    f"{obj['kind']}/{name_of(obj)} already owned by "
+                    f"{r['kind']}/{r['name']}"
+                )
+            return
+    refs.append(owner_reference(owner))
+
+
+def controller_owner(obj: dict) -> dict | None:
+    for r in obj["metadata"].get("ownerReferences", []):
+        if r.get("controller"):
+            return r
+    return None
+
+
+def matches_selector(labels: dict, selector: dict) -> bool:
+    """Kubernetes LabelSelector: matchLabels + matchExpressions
+    (In/NotIn/Exists/DoesNotExist)."""
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key, op = expr["key"], expr["operator"]
+        values = expr.get("values") or []
+        if op == "In":
+            if labels.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if key in labels and labels[key] in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            raise ValueError(f"unknown selector operator {op!r}")
+    return True
+
+
+def strategic_merge(base: Any, patch: Any) -> Any:
+    """Merge-patch semantics: dicts merge recursively, ``None`` deletes a
+    key, lists and scalars replace. (Good enough for the PATCH surface
+    the web apps and controllers use — the reference patches
+    annotations/replicas the same way.)"""
+    if isinstance(base, dict) and isinstance(patch, dict):
+        out = dict(base)
+        for k, v in patch.items():
+            if v is None:
+                out.pop(k, None)
+            elif k in out:
+                out[k] = strategic_merge(out[k], v)
+            else:
+                out[k] = copy.deepcopy(v)
+        return out
+    return copy.deepcopy(patch)
+
+
+def get_condition(obj: dict, ctype: str) -> dict | None:
+    for c in deep_get(obj, "status", "conditions", default=[]) or []:
+        if c.get("type") == ctype:
+            return c
+    return None
+
+
+def set_condition(obj: dict, condition: dict) -> None:
+    conds = obj.setdefault("status", {}).setdefault("conditions", [])
+    for i, c in enumerate(conds):
+        if c.get("type") == condition.get("type"):
+            conds[i] = condition
+            return
+    conds.append(condition)
+
+
+def parse_quantity(q) -> float:
+    """Parse a Kubernetes resource quantity ("500m", "1Gi", "4") to a
+    float in base units."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    suffixes = {
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+        "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+    }
+    for suf, mult in suffixes.items():
+        if s.endswith(suf):
+            return float(s[:-len(suf)]) * mult
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    return float(s)

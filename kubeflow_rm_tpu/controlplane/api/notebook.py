@@ -1,0 +1,96 @@
+"""Notebook resource: the platform's primary API object.
+
+Shape (mirrors the reference CRD — a Notebook wraps a full pod template,
+``notebook-controller/api/v1beta1/notebook_types.go:27-34`` — plus the
+TPU-native ``spec.tpu`` block that is this framework's reason to exist):
+
+    apiVersion: kubeflow.org/v1
+    kind: Notebook
+    metadata: {name, namespace, labels, annotations}
+    spec:
+      template:
+        spec:            # pod spec: containers[], volumes[], ...
+      tpu:               # optional — absent means a CPU notebook
+        acceleratorType: v5p-16
+    status:
+      conditions: [...]
+      readyReplicas: N
+      containerState: {...}
+
+Behavior annotations keep the reference's names (the *annotations* are
+the real control API — SURVEY.md §2.7), with TPU additions under the
+``notebooks.kubeflow.org/`` prefix.
+"""
+
+from __future__ import annotations
+
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+
+API_VERSION = "kubeflow.org/v1"
+KIND = "Notebook"
+
+# --- behavior annotations (reference names, pkg/culler/culler.go:40-41,
+# notebook_controller.go:51-53, jupyter .../form.py:10) ----------------
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+REWRITE_URI_ANNOTATION = "notebooks.kubeflow.org/http-rewrite-uri"
+HEADERS_ANNOTATION = "notebooks.kubeflow.org/http-headers-request-set"
+RESTART_ANNOTATION = "notebooks.kubeflow.org/notebook-restart"
+SERVER_TYPE_ANNOTATION = "notebooks.kubeflow.org/server-type"
+CULLING_EXCLUDE_ANNOTATION = "kubeflow-resource-culling-excluded"
+
+# TPU-native additions
+TPU_INJECT_EXCLUDE_ANNOTATION = "notebooks.kubeflow.org/tpu-inject-exclude"
+
+# label the controller stamps on everything it renders
+NOTEBOOK_NAME_LABEL = "notebook-name"
+# pod label carrying the slice's accelerator type (webhook + web apps read it)
+TPU_ACCELERATOR_LABEL = "notebooks.kubeflow.org/tpu-accelerator-type"
+
+
+def make_notebook(name: str, namespace: str, *,
+                  image: str = "jupyter-jax:latest",
+                  accelerator_type: str | None = None,
+                  labels: dict | None = None,
+                  annotations: dict | None = None,
+                  pod_spec_extra: dict | None = None,
+                  container_extra: dict | None = None) -> dict:
+    """Convenience constructor used by tests and the spawner backend."""
+    container = {
+        "name": name,
+        "image": image,
+        "ports": [{"containerPort": 8888, "name": "notebook-port",
+                   "protocol": "TCP"}],
+    }
+    if container_extra:
+        container.update(container_extra)
+    pod_spec: dict = {"containers": [container]}
+    if pod_spec_extra:
+        pod_spec.update(pod_spec_extra)
+    spec: dict = {"template": {"spec": pod_spec}}
+    if accelerator_type is not None:
+        spec["tpu"] = {"acceleratorType": accelerator_type}
+    return make_object(API_VERSION, KIND, name, namespace,
+                       labels=labels, annotations=annotations, spec=spec)
+
+
+def tpu_spec(notebook: dict) -> tpu_api.SliceTopology | None:
+    """Resolve spec.tpu to a SliceTopology (None for CPU notebooks)."""
+    t = deep_get(notebook, "spec", "tpu")
+    if not t:
+        return None
+    return tpu_api.lookup(t["acceleratorType"])
+
+
+def validate(notebook: dict) -> None:
+    """Structural validation (the CRD schema's job in the reference)."""
+    containers = deep_get(notebook, "spec", "template", "spec", "containers")
+    if not containers:
+        raise ValueError("notebook spec.template.spec.containers must be "
+                         "non-empty")
+    t = deep_get(notebook, "spec", "tpu")
+    if t is not None:
+        if "acceleratorType" not in t:
+            raise ValueError("spec.tpu requires acceleratorType")
+        tpu_api.lookup(t["acceleratorType"])  # raises on unknown

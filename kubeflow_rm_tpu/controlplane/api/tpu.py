@@ -1,0 +1,104 @@
+"""TPU slice topology model — the platform's accelerator vocabulary.
+
+The reference's accelerator model is a single resource-limit key chosen
+from a vendor list (``nvidia.com/gpu`` / ``amd.com/gpu`` —
+``crud-web-apps/jupyter/backend/apps/common/form.py:226-250``,
+``spawner_ui_config.yaml:119-135``). A TPU slice is richer: an
+accelerator *type* implies a chip topology, a number of hosts
+(one pod per host), chips per host, and the GKE node labels that the
+scheduler matches (``cloud.google.com/gke-tpu-accelerator``,
+``cloud.google.com/gke-tpu-topology``). This module is the single
+source of truth the controller, webhook, quota, and spawner all render
+from, so a Notebook only ever says ``tpu: {acceleratorType: v5p-16}``.
+
+Naming follows Cloud TPU: v5e slices are ``v5litepod-N`` with N =
+chips; v4/v5p slices are ``v{4,5p}-N`` with N = TensorCores
+(2 cores/chip), so v5p-8 is 4 chips on one host.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+GOOGLE_TPU_RESOURCE = "google.com/tpu"
+NODE_LABEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODE_LABEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    accelerator_type: str   # user-facing, e.g. "v5litepod-16"
+    gke_accelerator: str    # node label value, e.g. "tpu-v5-lite-podslice"
+    topology: str           # node label value, e.g. "4x4"
+    chips: int              # total chips in the slice
+    hosts: int              # pods per slice (one per host)
+    chip_flops_bf16: float  # peak dense bf16 FLOPs/sec per chip
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.hosts
+
+    @property
+    def multihost(self) -> bool:
+        return self.hosts > 1
+
+
+_V5E = "tpu-v5-lite-podslice"
+_V5P = "tpu-v5p-slice"
+_V4 = "tpu-v4-podslice"
+_V6E = "tpu-v6e-slice"
+
+_TOPOLOGIES = [
+    # v5e: 1 TensorCore/chip, 4-chip hosts (8-chip single-host variant for -8)
+    SliceTopology("v5litepod-1", _V5E, "1x1", 1, 1, 197e12),
+    SliceTopology("v5litepod-4", _V5E, "2x2", 4, 1, 197e12),
+    SliceTopology("v5litepod-8", _V5E, "2x4", 8, 1, 197e12),
+    SliceTopology("v5litepod-16", _V5E, "4x4", 16, 4, 197e12),
+    SliceTopology("v5litepod-32", _V5E, "4x8", 32, 8, 197e12),
+    SliceTopology("v5litepod-64", _V5E, "8x8", 64, 16, 197e12),
+    SliceTopology("v5litepod-128", _V5E, "8x16", 128, 32, 197e12),
+    SliceTopology("v5litepod-256", _V5E, "16x16", 256, 64, 197e12),
+    # v5p: 2 TensorCores/chip, 4-chip hosts, 3D torus topologies
+    SliceTopology("v5p-8", _V5P, "2x2x1", 4, 1, 459e12),
+    SliceTopology("v5p-16", _V5P, "2x2x2", 8, 2, 459e12),
+    SliceTopology("v5p-32", _V5P, "2x2x4", 16, 4, 459e12),
+    SliceTopology("v5p-64", _V5P, "2x4x4", 32, 8, 459e12),
+    SliceTopology("v5p-128", _V5P, "4x4x4", 64, 16, 459e12),
+    # v4: 2 TensorCores/chip, 4-chip hosts
+    SliceTopology("v4-8", _V4, "2x2x1", 4, 1, 275e12),
+    SliceTopology("v4-16", _V4, "2x2x2", 8, 2, 275e12),
+    SliceTopology("v4-32", _V4, "2x2x4", 16, 4, 275e12),
+    # v6e (Trillium): 1 TensorCore/chip, 4-chip hosts (8 for -8)
+    SliceTopology("v6e-1", _V6E, "1x1", 1, 1, 918e12),
+    SliceTopology("v6e-4", _V6E, "2x2", 4, 1, 918e12),
+    SliceTopology("v6e-8", _V6E, "2x4", 8, 1, 918e12),
+    SliceTopology("v6e-16", _V6E, "4x4", 16, 4, 918e12),
+    SliceTopology("v6e-32", _V6E, "4x8", 32, 8, 918e12),
+    SliceTopology("v6e-64", _V6E, "8x8", 64, 16, 918e12),
+]
+
+TOPOLOGIES: dict[str, SliceTopology] = {
+    t.accelerator_type: t for t in _TOPOLOGIES
+}
+
+
+class UnknownAcceleratorType(ValueError):
+    pass
+
+
+def lookup(accelerator_type: str) -> SliceTopology:
+    try:
+        return TOPOLOGIES[accelerator_type]
+    except KeyError:
+        raise UnknownAcceleratorType(
+            f"unknown TPU acceleratorType {accelerator_type!r}; known: "
+            f"{sorted(TOPOLOGIES)}"
+        ) from None
+
+
+def by_node_labels(gke_accelerator: str, topology: str) -> SliceTopology | None:
+    """Reverse lookup from GKE node labels (spawner capacity discovery)."""
+    for t in _TOPOLOGIES:
+        if t.gke_accelerator == gke_accelerator and t.topology == topology:
+            return t
+    return None

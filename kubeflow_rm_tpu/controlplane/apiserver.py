@@ -1,0 +1,356 @@
+"""In-memory Kubernetes-style apiserver: the envtest of this repo.
+
+The reference tests its controllers against a real kube-apiserver booted
+by envtest (``notebook-controller/controllers/suite_test.go:50-110``);
+this module provides the same contract hermetically: typed CRUD with
+resourceVersion conflicts, admission chains (where the mutating
+webhooks plug in), label-selector lists, watch events, finalizers +
+deletionTimestamp semantics, ownerReference cascade deletion, and
+ResourceQuota enforcement on pod admission. Controllers drive it
+through the same verbs they would use against a cluster.
+
+Cluster-scoped kinds are stored with namespace ``None``. Time is
+injected (``clock``) so culling/idleness tests are deterministic.
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import fnmatch
+from typing import Any, Callable
+
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    deep_get,
+    labels_of,
+    matches_selector,
+    name_of,
+    namespace_of,
+    new_uid,
+    parse_quantity,
+    strategic_merge,
+)
+
+CLUSTER_SCOPED_KINDS = {
+    "Namespace", "Profile", "Node", "ClusterRole", "ClusterRoleBinding",
+    "PersistentVolume", "CustomResourceDefinition",
+}
+
+
+class APIError(Exception):
+    pass
+
+
+class NotFound(APIError):
+    pass
+
+
+class AlreadyExists(APIError):
+    pass
+
+
+class Conflict(APIError):
+    pass
+
+
+class Invalid(APIError):
+    pass
+
+
+class AdmissionDenied(APIError):
+    pass
+
+
+def _utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+class APIServer:
+    def __init__(self, clock: Callable[[], datetime.datetime] = _utcnow):
+        self.clock = clock
+        self._store: dict[tuple[str, str | None, str], dict] = {}
+        self._rv = 0
+        # admission plugins: fn(op, obj, old) -> obj | None (op: CREATE/UPDATE)
+        self._admission: list[tuple[str, Callable]] = []
+        # validators per kind: fn(obj) raising on bad spec (CRD schema stand-in)
+        self._validators: dict[str, Callable[[dict], None]] = {}
+        self._watchers: list[Callable[[str, dict, dict | None], None]] = []
+        self._event_seq = 0
+        self.quota_enforcement = True
+
+    # ---- wiring ------------------------------------------------------
+    def register_admission(self, kind_pattern: str, fn: Callable) -> None:
+        """Register a mutating/validating admission plugin for kinds
+        matching ``kind_pattern`` (fnmatch, e.g. "Pod" or "*")."""
+        self._admission.append((kind_pattern, fn))
+
+    def register_validator(self, kind: str, fn: Callable[[dict], None]) -> None:
+        self._validators[kind] = fn
+
+    def add_watcher(self, fn: Callable[[str, dict, dict | None], None]) -> None:
+        self._watchers.append(fn)
+
+    # ---- helpers -----------------------------------------------------
+    def _key(self, kind: str, name: str, namespace: str | None):
+        if kind in CLUSTER_SCOPED_KINDS:
+            return (kind, None, name)
+        return (kind, namespace, name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _emit(self, event: str, obj: dict, old: dict | None = None) -> None:
+        for w in list(self._watchers):
+            w(event, copy.deepcopy(obj), copy.deepcopy(old) if old else None)
+
+    def _run_admission(self, op: str, obj: dict, old: dict | None) -> dict:
+        for pattern, fn in self._admission:
+            if fnmatch.fnmatch(obj["kind"], pattern):
+                result = fn(op, obj, old)
+                if result is not None:
+                    obj = result
+        return obj
+
+    def ensure_namespace(self, namespace: str) -> dict:
+        try:
+            return self.get("Namespace", namespace)
+        except NotFound:
+            return self.create({"apiVersion": "v1", "kind": "Namespace",
+                                "metadata": {"name": namespace}})
+
+    # ---- verbs -------------------------------------------------------
+    def create(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        kind = obj["kind"]
+        name, ns = name_of(obj), namespace_of(obj)
+        if kind in CLUSTER_SCOPED_KINDS:
+            ns = None
+            obj["metadata"].pop("namespace", None)
+        elif ns is None:
+            raise Invalid(f"{kind}/{name}: namespaced kind requires namespace")
+        else:
+            if ("Namespace", None, ns) not in self._store:
+                raise NotFound(f"namespace {ns!r} not found")
+        key = self._key(kind, name, ns)
+        if key in self._store:
+            raise AlreadyExists(f"{kind} {ns}/{name} already exists")
+        if kind in self._validators:
+            try:
+                self._validators[kind](obj)
+            except Exception as e:
+                raise Invalid(f"{kind} {ns}/{name}: {e}") from e
+        obj = self._run_admission("CREATE", obj, None)
+        if self.quota_enforcement and kind == "Pod":
+            self._enforce_quota(obj)
+        meta = obj["metadata"]
+        meta["uid"] = new_uid()
+        meta["resourceVersion"] = self._next_rv()
+        meta["creationTimestamp"] = self.clock().isoformat()
+        self._store[key] = obj
+        self._emit("ADDED", obj)
+        return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
+        key = self._key(kind, name, namespace)
+        if key not in self._store:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        return copy.deepcopy(self._store[key])
+
+    def try_get(self, kind: str, name: str,
+                namespace: str | None = None) -> dict | None:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict | None = None) -> list[dict]:
+        out = []
+        for (k, ns, _), obj in self._store.items():
+            if k != kind:
+                continue
+            if namespace is not None and ns != namespace:
+                continue
+            if label_selector and not matches_selector(
+                    labels_of(obj), label_selector):
+                continue
+            out.append(copy.deepcopy(obj))
+        out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
+        return out
+
+    def update(self, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        kind, name, ns = obj["kind"], name_of(obj), namespace_of(obj)
+        if kind in CLUSTER_SCOPED_KINDS:
+            ns = None
+        key = self._key(kind, name, ns)
+        if key not in self._store:
+            raise NotFound(f"{kind} {ns}/{name} not found")
+        old = self._store[key]
+        rv = obj["metadata"].get("resourceVersion")
+        if rv is not None and rv != old["metadata"]["resourceVersion"]:
+            raise Conflict(
+                f"{kind} {ns}/{name}: resourceVersion {rv} != "
+                f"{old['metadata']['resourceVersion']}"
+            )
+        if kind in self._validators:
+            try:
+                self._validators[kind](obj)
+            except Exception as e:
+                raise Invalid(f"{kind} {ns}/{name}: {e}") from e
+        obj = self._run_admission("UPDATE", obj, copy.deepcopy(old))
+        # immutable fields
+        obj["metadata"]["uid"] = old["metadata"]["uid"]
+        obj["metadata"]["creationTimestamp"] = old["metadata"]["creationTimestamp"]
+        if old["metadata"].get("deletionTimestamp"):
+            obj["metadata"]["deletionTimestamp"] = \
+                old["metadata"]["deletionTimestamp"]
+        obj["metadata"]["resourceVersion"] = self._next_rv()
+        self._store[key] = obj
+        # a deleting object whose finalizers have all been removed goes away
+        if obj["metadata"].get("deletionTimestamp") and \
+                not obj["metadata"].get("finalizers"):
+            return self._finalize_delete(key)
+        self._emit("MODIFIED", obj, old)
+        return copy.deepcopy(obj)
+
+    def patch(self, kind: str, name: str, patch: dict,
+              namespace: str | None = None) -> dict:
+        current = self.get(kind, name, namespace)
+        merged = strategic_merge(current, patch)
+        merged["metadata"]["resourceVersion"] = \
+            current["metadata"]["resourceVersion"]
+        return self.update(merged)
+
+    def update_status(self, obj: dict) -> dict:
+        """Status-subresource write: only ``status`` is applied."""
+        current = self.get(obj["kind"], name_of(obj), namespace_of(obj))
+        current["status"] = copy.deepcopy(obj.get("status", {}))
+        return self.update(current)
+
+    def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
+        key = self._key(kind, name, namespace)
+        if key not in self._store:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        obj = self._store[key]
+        if obj["metadata"].get("finalizers"):
+            if not obj["metadata"].get("deletionTimestamp"):
+                obj["metadata"]["deletionTimestamp"] = self.clock().isoformat()
+                obj["metadata"]["resourceVersion"] = self._next_rv()
+                self._emit("MODIFIED", obj)
+            return
+        self._finalize_delete(key)
+
+    def _finalize_delete(self, key) -> dict:
+        obj = self._store.pop(key)
+        self._emit("DELETED", obj)
+        self._garbage_collect(obj)
+        if obj["kind"] == "Namespace":
+            # namespace deletion drains everything inside it
+            ns = name_of(obj)
+            for (kind, kns, name) in [k for k in self._store if k[1] == ns]:
+                try:
+                    self.delete(kind, name, kns)
+                except NotFound:
+                    pass
+        return copy.deepcopy(obj)
+
+    def _garbage_collect(self, owner: dict) -> None:
+        """Cascade-delete dependents referencing the deleted owner's uid."""
+        owner_uid = owner["metadata"].get("uid")
+        if not owner_uid:
+            return
+        dependents = [
+            (k, obj) for k, obj in list(self._store.items())
+            if any(r.get("uid") == owner_uid
+                   for r in obj["metadata"].get("ownerReferences", []))
+        ]
+        for (kind, ns, name), _ in dependents:
+            try:
+                self.delete(kind, name, ns)
+            except NotFound:
+                pass
+
+    # ---- events ------------------------------------------------------
+    def record_event(self, involved: dict, etype: str, reason: str,
+                     message: str) -> dict:
+        """Create a v1 Event for ``involved`` (controller event recorder)."""
+        self._event_seq += 1
+        ns = namespace_of(involved) or "default"
+        ev = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{name_of(involved)}.{self._event_seq:08x}",
+                "namespace": ns,
+            },
+            "type": etype,
+            "reason": reason,
+            "message": message,
+            "involvedObject": {
+                "kind": involved["kind"],
+                "name": name_of(involved),
+                "namespace": ns,
+                "uid": involved["metadata"].get("uid"),
+            },
+            "firstTimestamp": self.clock().isoformat(),
+            "lastTimestamp": self.clock().isoformat(),
+            "count": 1,
+        }
+        return self.create(ev)
+
+    def events_for(self, involved: dict) -> list[dict]:
+        ns = namespace_of(involved)
+        return [
+            e for e in self.list("Event", ns)
+            if deep_get(e, "involvedObject", "name") == name_of(involved)
+            and deep_get(e, "involvedObject", "kind") == involved["kind"]
+        ]
+
+    # ---- ResourceQuota enforcement (kube-apiserver built-in) ---------
+    def _enforce_quota(self, pod: dict) -> None:
+        ns = namespace_of(pod)
+        quotas = self.list("ResourceQuota", ns)
+        if not quotas:
+            return
+        pods = [p for p in self.list("Pod", ns)
+                if not p["metadata"].get("deletionTimestamp")]
+
+        def pod_resource(p: dict, resource: str, kind: str) -> float:
+            """kind='requests': requests, defaulting to limits (kube
+            defaulting); kind='limits': limits only."""
+            total = 0.0
+            for c in deep_get(p, "spec", "containers", default=[]) or []:
+                if kind == "limits":
+                    amount = deep_get(c, "resources", "limits", resource)
+                else:
+                    amount = deep_get(c, "resources", "requests", resource)
+                    if amount is None:
+                        amount = deep_get(c, "resources", "limits", resource)
+                if amount is not None:
+                    total += parse_quantity(amount)
+            return total
+
+        for quota in quotas:
+            hard = deep_get(quota, "spec", "hard", default={}) or {}
+            for resource, limit in hard.items():
+                limit_v = parse_quantity(limit)
+                if resource == "pods":
+                    used = float(len(pods))
+                    requested = 1.0
+                else:
+                    rname, rkind = resource, "requests"
+                    if rname.startswith("requests."):
+                        rname = rname[len("requests."):]
+                    elif rname.startswith("limits."):
+                        rname = rname[len("limits."):]
+                        rkind = "limits"
+                    used = sum(pod_resource(p, rname, rkind) for p in pods)
+                    requested = pod_resource(pod, rname, rkind)
+                if requested and used + requested > limit_v:
+                    raise AdmissionDenied(
+                        f"exceeded quota {name_of(quota)}: requested "
+                        f"{resource}={requested:g}, used {used:g}, "
+                        f"limited {limit_v:g}"
+                    )

@@ -1,0 +1,250 @@
+"""Notebook reconciler: Notebook CR → TPU-slice StatefulSet + Services.
+
+TPU-first rework of the reference core reconciler
+(``notebook-controller/controllers/notebook_controller.go``):
+
+- ``generateStatefulSet`` (ref ``:408-484``): here replicas =
+  hosts-per-slice (the reference hardcodes replicas ∈ {0,1} — ``:409-412``),
+  ``podManagementPolicy: Parallel`` for multihost slices (rendezvous
+  needs all workers up together, not ordered), ``google.com/tpu`` chip
+  limits and ``gke-tpu-*`` nodeSelectors rendered from ``spec.tpu``.
+- two Services instead of one (ref ``generateService`` ``:486-513``): a
+  ClusterIP service pinned to worker-0 (the Jupyter UI lives there) and
+  a headless service over all workers (stable per-ordinal DNS — the
+  rendezvous substrate the webhook's TPU_WORKER_HOSTNAMES points at).
+- stop-annotation → replicas=0 (``:410-412``), whole slice at once: a
+  TPU slice is all-or-nothing.
+- status mirroring from pod ordinal 0 (ref ``updateNotebookStatus``
+  ``:274-349``) plus slice-aware readyReplicas.
+- pod-event re-emission onto the Notebook (ref ``:94-123,662-736``) so
+  users see FailedScheduling (no free slice) on the CR itself.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    annotations_of,
+    deep_get,
+    name_of,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer, NotFound
+from kubeflow_rm_tpu.controlplane.runtime import (
+    Controller,
+    Request,
+    copy_service_fields,
+    copy_statefulset_fields,
+    map_by_label,
+    map_to_owner,
+    reconcile_child,
+)
+from kubeflow_rm_tpu.controlplane import metrics
+
+DEFAULT_CONTAINER_PORT = 8888
+SERVICE_PORT = 80
+
+
+def headless_name(notebook_name: str) -> str:
+    return f"{notebook_name}-workers"
+
+
+class NotebookController(Controller):
+    kind = nb_api.KIND
+
+    def watches(self):
+        return (
+            ("StatefulSet", map_to_owner(nb_api.KIND)),
+            ("Pod", map_by_label(nb_api.NOTEBOOK_NAME_LABEL)),
+            ("Event", _map_event_to_notebook),
+        )
+
+    def reconcile(self, api: APIServer, req: Request):
+        try:
+            notebook = api.get(nb_api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None  # children follow via GC
+
+        topo = nb_api.tpu_spec(notebook)
+        sts = self._generate_statefulset(notebook, topo)
+        creating = api.try_get("StatefulSet", req.name, req.namespace) is None
+        try:
+            reconcile_child(api, notebook, sts, copy_statefulset_fields)
+        except Exception:
+            if creating:
+                metrics.NOTEBOOK_CREATE_FAILED_TOTAL.inc()
+            raise
+        if creating:
+            metrics.NOTEBOOK_CREATE_TOTAL.inc()
+
+        for svc in self._generate_services(notebook, topo):
+            reconcile_child(api, notebook, svc, copy_service_fields)
+
+        self._mirror_status(api, notebook, topo)
+        self._reemit_pod_events(api, notebook)
+        return None
+
+    # -- rendering -----------------------------------------------------
+    def _generate_statefulset(self, notebook: dict,
+                              topo: tpu_api.SliceTopology | None) -> dict:
+        name = name_of(notebook)
+        ns = notebook["metadata"]["namespace"]
+        hosts = topo.hosts if topo else 1
+        stopped = nb_api.STOP_ANNOTATION in annotations_of(notebook)
+        replicas = 0 if stopped else hosts
+
+        pod_spec = copy.deepcopy(
+            deep_get(notebook, "spec", "template", "spec", default={}))
+        containers = pod_spec.get("containers") or []
+        if containers:
+            c0 = containers[0]
+            env = c0.setdefault("env", [])
+            _upsert_env(env, "NB_PREFIX", f"/notebook/{ns}/{name}")
+        pod_labels = {
+            "statefulset": name,
+            nb_api.NOTEBOOK_NAME_LABEL: name,
+        }
+        pod_annotations = {}
+        if topo:
+            pod_labels[nb_api.TPU_ACCELERATOR_LABEL] = topo.accelerator_type
+            if containers:
+                limits = containers[0].setdefault("resources", {}) \
+                    .setdefault("limits", {})
+                limits[tpu_api.GOOGLE_TPU_RESOURCE] = str(topo.chips_per_host)
+            sel = pod_spec.setdefault("nodeSelector", {})
+            sel[tpu_api.NODE_LABEL_ACCELERATOR] = topo.gke_accelerator
+            sel[tpu_api.NODE_LABEL_TOPOLOGY] = topo.topology
+
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": {nb_api.NOTEBOOK_NAME_LABEL: name},
+            },
+            "spec": {
+                "replicas": replicas,
+                "serviceName": headless_name(name),
+                "podManagementPolicy": "Parallel" if hosts > 1
+                                       else "OrderedReady",
+                "selector": {"matchLabels": {"statefulset": name}},
+                "template": {
+                    "metadata": {"labels": pod_labels,
+                                 "annotations": pod_annotations},
+                    "spec": pod_spec,
+                },
+            },
+        }
+
+    def _generate_services(self, notebook: dict,
+                           topo: tpu_api.SliceTopology | None) -> list[dict]:
+        name = name_of(notebook)
+        ns = notebook["metadata"]["namespace"]
+        # UI service: port 80 → 8888 on worker 0 only
+        ui = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": {nb_api.NOTEBOOK_NAME_LABEL: name}},
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {
+                    "statefulset.kubernetes.io/pod-name": f"{name}-0"},
+                "ports": [{
+                    "name": "http-" + name,
+                    "port": SERVICE_PORT,
+                    "targetPort": DEFAULT_CONTAINER_PORT,
+                    "protocol": "TCP",
+                }],
+            },
+        }
+        # headless worker service: stable DNS for every ordinal
+        workers = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": headless_name(name), "namespace": ns,
+                         "labels": {nb_api.NOTEBOOK_NAME_LABEL: name}},
+            "spec": {
+                "type": "ClusterIP",
+                "clusterIP": "None",
+                "selector": {"statefulset": name},
+                "ports": [{"name": "jax-coordinator", "port": 8476,
+                           "targetPort": 8476, "protocol": "TCP"}],
+            },
+        }
+        return [ui, workers]
+
+    # -- status --------------------------------------------------------
+    def _mirror_status(self, api: APIServer, notebook: dict,
+                       topo: tpu_api.SliceTopology | None) -> None:
+        name, ns = name_of(notebook), notebook["metadata"]["namespace"]
+        hosts = topo.hosts if topo else 1
+        sts = api.try_get("StatefulSet", name, ns)
+        ready = deep_get(sts, "status", "readyReplicas", default=0) if sts \
+            else 0
+        status: dict = {
+            "readyReplicas": ready,
+            "desiredReplicas": 0 if nb_api.STOP_ANNOTATION in
+            annotations_of(notebook) else hosts,
+        }
+        pod0 = api.try_get("Pod", f"{name}-0", ns)
+        if pod0:
+            cs = deep_get(pod0, "status", "containerStatuses", 0)
+            if cs:
+                status["containerState"] = cs.get("state", {})
+            status["conditions"] = [
+                {"type": c.get("type"), "status": c.get("status")}
+                for c in deep_get(pod0, "status", "conditions",
+                                  default=[]) or []
+            ]
+        if deep_get(notebook, "status") != status:
+            notebook["status"] = status
+            api.update_status(notebook)
+        metrics.NOTEBOOK_RUNNING.set(self._count_running(api))
+
+    def _count_running(self, api: APIServer) -> int:
+        n = 0
+        for nb in api.list(nb_api.KIND):
+            if deep_get(nb, "status", "readyReplicas", default=0) >= 1:
+                n += 1
+        return n
+
+    # -- event re-emission (ref :662-736) ------------------------------
+    def _reemit_pod_events(self, api: APIServer, notebook: dict) -> None:
+        name, ns = name_of(notebook), notebook["metadata"]["namespace"]
+        pods = api.list("Pod", ns, {"matchLabels":
+                                    {nb_api.NOTEBOOK_NAME_LABEL: name}})
+        already = {
+            (e.get("reason"), e.get("message"))
+            for e in api.events_for(notebook)
+        }
+        for pod in pods:
+            for ev in api.events_for(pod):
+                if ev.get("type") != "Warning":
+                    continue  # only surface problems, as the ref predicate does
+                sig = (ev.get("reason"),
+                       f"[pod {name_of(pod)}] {ev.get('message')}")
+                if sig in already:
+                    continue
+                already.add(sig)
+                api.record_event(notebook, "Warning", sig[0], sig[1])
+
+
+def _map_event_to_notebook(event_obj: dict):
+    inv = event_obj.get("involvedObject") or {}
+    if inv.get("kind") == "Pod" and inv.get("name"):
+        # pod name {notebook}-{ordinal}
+        base = inv["name"].rsplit("-", 1)[0]
+        return [Request(inv.get("namespace"), base)]
+    return []
+
+
+def _upsert_env(env: list, name: str, value: str) -> None:
+    for e in env:
+        if e.get("name") == name:
+            e["value"] = value
+            return
+    env.append({"name": name, "value": value})

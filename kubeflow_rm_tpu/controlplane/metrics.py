@@ -1,0 +1,60 @@
+"""Prometheus metrics for the control plane.
+
+Mirrors the reference's collector set
+(``notebook-controller/pkg/metrics/metrics.go:13-99`` and
+``profile-controller/controllers/monitoring.go:30-43``) on a dedicated
+registry so tests can scrape and reset it hermetically.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    generate_latest,
+)
+
+REGISTRY = CollectorRegistry()
+
+NOTEBOOK_RUNNING = Gauge(
+    "notebook_running",
+    "Current number of notebooks with at least one ready replica",
+    registry=REGISTRY,
+)
+NOTEBOOK_CREATE_TOTAL = Counter(
+    "notebook_create_total",
+    "Total notebook StatefulSets created",
+    registry=REGISTRY,
+)
+NOTEBOOK_CREATE_FAILED_TOTAL = Counter(
+    "notebook_create_failed_total",
+    "Total notebook StatefulSet creations that failed",
+    registry=REGISTRY,
+)
+NOTEBOOK_CULL_TOTAL = Counter(
+    "notebook_cull_total",
+    "Total notebooks culled for idleness",
+    registry=REGISTRY,
+)
+PROFILE_CREATE_TOTAL = Counter(
+    "profile_create_total",
+    "Total profiles reconciled into namespaces",
+    registry=REGISTRY,
+)
+RECONCILE_ERRORS_TOTAL = Counter(
+    "reconcile_errors_total",
+    "Total reconcile errors across controllers",
+    ["controller"],
+    registry=REGISTRY,
+)
+TPU_CHIPS_REQUESTED = Gauge(
+    "tpu_chips_requested",
+    "TPU chips currently requested by scheduled notebook pods",
+    registry=REGISTRY,
+)
+
+
+def scrape() -> bytes:
+    """Prometheus exposition text for the control-plane registry."""
+    return generate_latest(REGISTRY)

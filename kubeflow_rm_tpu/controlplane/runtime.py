@@ -1,0 +1,274 @@
+"""Controller runtime: watches, work queues, level-triggered reconciling.
+
+A thin, deterministic stand-in for controller-runtime: each Controller
+reconciles one primary kind, may watch other kinds mapped back to
+primary requests (the reference watches Pods and Events and maps them to
+their parent Notebook — ``notebook_controller.go:739-787``), and the
+Manager drains all queues to quiescence. ``requeue_after`` plus the
+injected clock give the culler its periodic loop without wall-clock
+sleeps.
+
+Reconcilers must be idempotent and cheap — run_until_idle re-runs them
+until nothing changes, which is exactly the level-triggered semantics
+the reference relies on for failure recovery (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    annotations_of,
+    deep_get,
+    labels_of,
+    name_of,
+    namespace_of,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer, Conflict, NotFound
+
+
+@dataclass(frozen=True, order=True)
+class Request:
+    namespace: str | None
+    name: str
+
+
+class Controller:
+    """Subclass contract: set ``kind``, implement ``reconcile``."""
+
+    kind: str = ""
+    name: str = ""
+
+    def reconcile(self, api: APIServer, req: Request) -> float | None:
+        """Reconcile one object. Return seconds to requeue after, or
+        None. Raise to retry with backoff."""
+        raise NotImplementedError
+
+    def watches(self) -> Iterable[tuple[str, Callable[[dict], list[Request]]]]:
+        """Extra (kind, map_fn) watches; map_fn maps an event's object to
+        primary requests."""
+        return ()
+
+
+def map_to_owner(owner_kind: str) -> Callable[[dict], list[Request]]:
+    """Map a dependent object to its controller-owner of ``owner_kind``."""
+
+    def fn(obj: dict) -> list[Request]:
+        for ref in obj["metadata"].get("ownerReferences", []):
+            if ref.get("kind") == owner_kind and ref.get("controller"):
+                return [Request(namespace_of(obj), ref["name"])]
+        return []
+
+    return fn
+
+
+def map_by_label(label: str) -> Callable[[dict], list[Request]]:
+    def fn(obj: dict) -> list[Request]:
+        v = labels_of(obj).get(label)
+        return [Request(namespace_of(obj), v)] if v else []
+
+    return fn
+
+
+class Manager:
+    """Runs controllers against an APIServer until the system is idle."""
+
+    MAX_RETRIES = 5
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.controllers: list[Controller] = []
+        self._queues: dict[str, set[Request]] = {}
+        # (due_time, controller_name, request)
+        self._timed: list[tuple[datetime.datetime, str, Request]] = []
+        self._retries: dict[tuple[str, Request], int] = {}
+        self.errors: list[tuple[str, Request, Exception]] = []
+        api.add_watcher(self._on_event)
+
+    def add(self, controller: Controller) -> None:
+        if not controller.name:
+            controller.name = type(controller).__name__
+        self.controllers.append(controller)
+        self._queues.setdefault(controller.name, set())
+
+    def enqueue(self, controller: Controller | str, req: Request) -> None:
+        name = controller if isinstance(controller, str) else controller.name
+        self._queues[name].add(req)
+
+    def enqueue_all(self) -> None:
+        """Seed every controller's queue with all existing primaries
+        (informer initial list)."""
+        for c in self.controllers:
+            for obj in self.api.list(c.kind):
+                self.enqueue(c, Request(namespace_of(obj), name_of(obj)))
+
+    def _on_event(self, event: str, obj: dict, old: dict | None) -> None:
+        for c in self.controllers:
+            if obj["kind"] == c.kind:
+                self.enqueue(c, Request(namespace_of(obj), name_of(obj)))
+            for kind, map_fn in c.watches():
+                if obj["kind"] == kind:
+                    for req in map_fn(obj):
+                        if req.name:
+                            self.enqueue(c, req)
+
+    def _due_timed(self) -> list[tuple[str, Request]]:
+        now = self.api.clock()
+        due = [(n, r) for (t, n, r) in self._timed if t <= now]
+        self._timed = [(t, n, r) for (t, n, r) in self._timed if t > now]
+        return due
+
+    def run_until_idle(self, max_iterations: int = 10_000) -> int:
+        """Process queues until empty (timed requeues fire only when the
+        injected clock passes them). Returns reconcile count."""
+        count = 0
+        for _ in range(max_iterations):
+            for cname, req in self._due_timed():
+                self._queues[cname].add(req)
+            pending = [(c, req) for c in self.controllers
+                       for req in sorted(self._queues[c.name])]
+            if not pending:
+                return count
+            for c, req in pending:
+                self._queues[c.name].discard(req)
+                count += 1
+                try:
+                    requeue_after = c.reconcile(self.api, req)
+                    self._retries.pop((c.name, req), None)
+                    if requeue_after is not None:
+                        due = self.api.clock() + datetime.timedelta(
+                            seconds=requeue_after)
+                        self._timed.append((due, c.name, req))
+                except (Conflict,) as e:
+                    self._retry(c, req, e)
+                except NotFound:
+                    pass  # object vanished; level-triggered — nothing to do
+                except Exception as e:  # reconcile error: retry w/ backoff
+                    self._retry(c, req, e)
+        raise RuntimeError(
+            f"manager did not quiesce in {max_iterations} iterations "
+            f"(hot objects: { {c.name: sorted(self._queues[c.name]) for c in self.controllers if self._queues[c.name]} })"
+        )
+
+    def _retry(self, c: Controller, req: Request, e: Exception) -> None:
+        from kubeflow_rm_tpu.controlplane import metrics
+        metrics.RECONCILE_ERRORS_TOTAL.labels(controller=c.name).inc()
+        k = (c.name, req)
+        n = self._retries.get(k, 0) + 1
+        self._retries[k] = n
+        if n <= self.MAX_RETRIES:
+            self._queues[c.name].add(req)
+        else:
+            self.errors.append((c.name, req, e))
+
+
+def rwo_mounting_node(api: APIServer, namespace: str,
+                      pvc_name: str) -> str | None:
+    """Node pinning for ReadWriteOnce PVCs: the node where a running pod
+    already mounts the claim, or None (shared by the tensorboard and
+    pvcviewer controllers — ref ``tensorboard_controller.go:207-232``)."""
+    pvc = api.try_get("PersistentVolumeClaim", pvc_name, namespace)
+    if pvc is None:
+        return None
+    modes = deep_get(pvc, "spec", "accessModes", default=[]) or []
+    if "ReadWriteOnce" not in modes:
+        return None
+    for pod in api.list("Pod", namespace):
+        node = deep_get(pod, "spec", "nodeName")
+        if not node or deep_get(pod, "status", "phase") != "Running":
+            continue
+        for v in deep_get(pod, "spec", "volumes", default=[]) or []:
+            if deep_get(v, "persistentVolumeClaim",
+                        "claimName") == pvc_name:
+                return node
+    return None
+
+
+# ---- reconcilehelper: create-or-update field-copy semantics ----------
+# Mirrors components/common/reconcilehelper/util.go:18-219 — deliberately
+# copy only the fields the controller owns, so we don't fight defaulters
+# or status writers.
+
+def reconcile_child(api: APIServer, owner: dict, desired: dict,
+                    copy_fields: Callable[[dict, dict], bool]) -> dict:
+    """Create ``desired`` (owned by ``owner``) if absent; else copy the
+    controller-owned fields onto the found object and update when
+    changed. Returns the live object."""
+    from kubeflow_rm_tpu.controlplane.api.meta import set_controller_reference
+
+    set_controller_reference(owner, desired)
+    found = api.try_get(desired["kind"], name_of(desired),
+                        namespace_of(desired))
+    if found is None:
+        return api.create(desired)
+    if copy_fields(desired, found):
+        return api.update(found)
+    return found
+
+
+def copy_statefulset_fields(desired: dict, found: dict) -> bool:
+    """Replicas, labels, annotations, pod template (util.go:107-134)."""
+    changed = False
+    for field in ("labels", "annotations"):
+        want = desired["metadata"].get(field) or {}
+        if (found["metadata"].get(field) or {}) != want:
+            found["metadata"][field] = dict(want)
+            changed = True
+    if deep_get(desired, "spec", "replicas") != deep_get(found, "spec",
+                                                         "replicas"):
+        found.setdefault("spec", {})["replicas"] = deep_get(
+            desired, "spec", "replicas")
+        changed = True
+    if deep_get(desired, "spec", "template") != deep_get(found, "spec",
+                                                         "template"):
+        found["spec"]["template"] = deep_get(desired, "spec", "template")
+        changed = True
+    return changed
+
+
+def copy_service_fields(desired: dict, found: dict) -> bool:
+    """Selector + ports only; clusterIP etc. belong to the cluster
+    (util.go:166-219)."""
+    changed = False
+    for field in ("labels", "annotations"):
+        want = desired["metadata"].get(field) or {}
+        if (found["metadata"].get(field) or {}) != want:
+            found["metadata"][field] = dict(want)
+            changed = True
+    for key in ("selector", "ports", "clusterIP", "type"):
+        want = deep_get(desired, "spec", key)
+        if want is not None and deep_get(found, "spec", key) != want:
+            found.setdefault("spec", {})[key] = want
+            changed = True
+    return changed
+
+
+def copy_deployment_fields(desired: dict, found: dict) -> bool:
+    return copy_statefulset_fields(desired, found)
+
+
+def copy_simple_spec(desired: dict, found: dict) -> bool:
+    """Whole-spec ownership (quota, RBAC, network policy objects)."""
+    changed = False
+    for field in ("labels", "annotations"):
+        want = desired["metadata"].get(field) or {}
+        if (found["metadata"].get(field) or {}) != want:
+            found["metadata"][field] = dict(want)
+            changed = True
+    for top in ("spec", "rules", "roleRef", "subjects", "data"):
+        if top in desired and found.get(top) != desired[top]:
+            found[top] = desired[top]
+            changed = True
+    return changed
+
+
+def stamp(obj: dict) -> str:
+    """Debug stamp kind/ns/name."""
+    return f"{obj['kind']}/{namespace_of(obj)}/{name_of(obj)}"
+
+
+def is_stopped(obj: dict) -> bool:
+    from kubeflow_rm_tpu.controlplane.api.notebook import STOP_ANNOTATION
+    return STOP_ANNOTATION in annotations_of(obj)

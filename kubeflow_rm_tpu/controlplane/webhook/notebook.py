@@ -1,0 +1,118 @@
+"""Notebook mutating webhook: lock protocol, image resolution,
+no-restart guard.
+
+Re-implements the ODH NotebookWebhook
+(``odh-notebook-controller/controllers/notebook_webhook.go``):
+
+- **Reconciliation lock** (``:63-74``): on CREATE the webhook stamps the
+  stop-annotation with the lock value, so the reconciler renders
+  replicas=0 until prerequisites settle; the LockReleaseController
+  below removes it (the ODH controller does this after the pull secret
+  is mounted, with retry — ``notebook_controller.go:118-146``).
+- **Image resolution** (``SetContainerImageFromRegistry`` ``:541-640``):
+  short image names are resolved through the ``notebook-images``
+  ConfigMap (the TPU stack's stand-in for OpenShift ImageStreams).
+- **No-restart guard** (``maybeRestartRunningNotebook`` ``:314-371``):
+  pod-template-affecting updates to a RUNNING notebook are rejected
+  unless the restart annotation opts in — a multi-host TPU slice makes
+  surprise restarts N times more expensive than the reference's single
+  pod.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    annotations_of,
+    deep_get,
+    name_of,
+    namespace_of,
+    remove_annotation,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import (
+    AdmissionDenied, APIServer, NotFound,
+)
+from kubeflow_rm_tpu.controlplane.runtime import Controller, Request
+
+LOCK_VALUE = "reconciliation-lock"
+IMAGE_CONFIGMAP = "notebook-images"
+IMAGE_CONFIGMAP_NAMESPACE = "kubeflow"
+
+
+class NotebookWebhook:
+    def __init__(self, api: APIServer):
+        self.api = api
+
+    def register(self) -> None:
+        self.api.register_admission(nb_api.KIND, self)
+
+    def __call__(self, op: str, notebook: dict,
+                 old: dict | None) -> dict | None:
+        if op == "CREATE":
+            notebook = copy.deepcopy(notebook)
+            self._inject_lock(notebook)
+            self._resolve_image(notebook)
+            return notebook
+        if op == "UPDATE" and old is not None:
+            self._guard_restart(notebook, old)
+            return None
+        return None
+
+    def _inject_lock(self, notebook: dict) -> None:
+        ann = notebook["metadata"].setdefault("annotations", {})
+        ann.setdefault(nb_api.STOP_ANNOTATION, LOCK_VALUE)
+
+    def _resolve_image(self, notebook: dict) -> None:
+        cm = self.api.try_get("ConfigMap", IMAGE_CONFIGMAP,
+                              IMAGE_CONFIGMAP_NAMESPACE)
+        if cm is None:
+            return
+        images = cm.get("data") or {}
+        containers = deep_get(notebook, "spec", "template", "spec",
+                              "containers", default=[]) or []
+        for c in containers:
+            img = c.get("image", "")
+            if img in images:
+                c["image"] = images[img]
+
+    def _guard_restart(self, new: dict, old: dict) -> None:
+        old_ann = annotations_of(old)
+        new_ann = annotations_of(new)
+        stopped = nb_api.STOP_ANNOTATION in old_ann
+        if stopped:
+            return  # stopped notebooks may change freely
+        old_tmpl = deep_get(old, "spec", "template")
+        new_tmpl = deep_get(new, "spec", "template")
+        tpu_changed = deep_get(old, "spec", "tpu") != deep_get(new, "spec",
+                                                               "tpu")
+        if old_tmpl == new_tmpl and not tpu_changed:
+            return
+        if new_ann.get(nb_api.RESTART_ANNOTATION) == "true":
+            return  # explicit opt-in
+        raise AdmissionDenied(
+            f"Notebook {namespace_of(new)}/{name_of(new)} is running; "
+            "spec changes would restart the slice. Stop it first or set "
+            f"annotation {nb_api.RESTART_ANNOTATION}=true"
+        )
+
+
+class LockReleaseController(Controller):
+    """Removes the webhook's reconciliation lock once the notebook's
+    prerequisites exist (ref ``notebook_controller.go:118-146`` waits on
+    the pull secret; here: the namespace is fully provisioned)."""
+
+    kind = nb_api.KIND
+
+    def reconcile(self, api: APIServer, req: Request):
+        try:
+            notebook = api.get(nb_api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        ann = annotations_of(notebook)
+        if ann.get(nb_api.STOP_ANNOTATION) != LOCK_VALUE:
+            return None
+        remove_annotation(notebook, nb_api.STOP_ANNOTATION)
+        api.update(notebook)
+        return None

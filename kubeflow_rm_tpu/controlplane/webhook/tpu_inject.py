@@ -1,0 +1,115 @@
+"""TPU rendezvous injection webhook — the north-star seam.
+
+The producer half of the contract consumed by
+``kubeflow_rm_tpu/parallel/distributed.py``: every pod of a TPU-slice
+notebook gets
+
+- ``TPU_WORKER_ID``        — its ordinal (from the StatefulSet pod name),
+- ``TPU_WORKER_HOSTNAMES`` — comma-joined stable DNS of all workers
+  through the headless service,
+- ``TPU_ACCELERATOR_TYPE`` / ``TPU_TOPOLOGY`` — the slice shape, so
+  in-notebook code can build the right ``jax.sharding.Mesh``,
+- a ``/dev/shm`` Memory volume (the reference injects the same for
+  NCCL DDP — ``jupyter .../form.py:264-276``; libtpu uses shm for its
+  per-host IPC too).
+
+The reference has no counterpart (its servers are single-pod,
+``notebook_controller.go:409-412``); SURVEY.md §2.6 designates the
+PodDefault merge point as the natural home for this injection, which is
+exactly where this webhook sits in the admission chain.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    annotations_of,
+    deep_get,
+    labels_of,
+    name_of,
+    namespace_of,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+
+SHM_VOLUME = {"name": "dshm", "emptyDir": {"medium": "Memory"}}
+SHM_MOUNT = {"name": "dshm", "mountPath": "/dev/shm"}
+
+
+class TpuInjectWebhook:
+    def __init__(self, api: APIServer, cluster_domain: str = "cluster.local"):
+        self.api = api
+        self.cluster_domain = cluster_domain
+
+    def register(self) -> None:
+        self.api.register_admission("Pod", self)
+
+    def __call__(self, op: str, pod: dict, old: dict | None) -> dict | None:
+        if op != "CREATE":
+            return None
+        if annotations_of(pod).get(
+                nb_api.TPU_INJECT_EXCLUDE_ANNOTATION) == "true":
+            return None
+        acc_type = labels_of(pod).get(nb_api.TPU_ACCELERATOR_LABEL)
+        if not acc_type:
+            return None
+        topo = tpu_api.lookup(acc_type)
+
+        ordinal = _pod_ordinal(pod)
+        hostnames = self._worker_hostnames(pod, topo)
+
+        pod = copy.deepcopy(pod)
+        spec = pod["spec"]
+        for c in spec.get("containers") or []:
+            env = c.setdefault("env", [])
+            _upsert(env, "TPU_WORKER_ID", str(ordinal))
+            _upsert(env, "TPU_WORKER_HOSTNAMES", ",".join(hostnames))
+            _upsert(env, "TPU_ACCELERATOR_TYPE", topo.accelerator_type)
+            _upsert(env, "TPU_TOPOLOGY", topo.topology)
+            mounts = c.setdefault("volumeMounts", [])
+            if not any(m.get("mountPath") == "/dev/shm" for m in mounts):
+                mounts.append(dict(SHM_MOUNT))
+        vols = spec.setdefault("volumes", [])
+        if not any(v.get("name") == SHM_VOLUME["name"] for v in vols):
+            vols.append(copy.deepcopy(SHM_VOLUME))
+        return pod
+
+    def _worker_hostnames(self, pod: dict,
+                          topo: tpu_api.SliceTopology) -> list[str]:
+        subdomain = deep_get(pod, "spec", "subdomain")
+        ns = namespace_of(pod)
+        base = _base_name(pod)
+        if not subdomain:
+            # single-host fallback: the pod's own DNS
+            return [f"{name_of(pod)}.{ns}.svc.{self.cluster_domain}"]
+        return [
+            f"{base}-{i}.{subdomain}.{ns}.svc.{self.cluster_domain}"
+            for i in range(topo.hosts)
+        ]
+
+
+def _pod_ordinal(pod: dict) -> int:
+    name = labels_of(pod).get("statefulset.kubernetes.io/pod-name") \
+        or name_of(pod)
+    tail = name.rsplit("-", 1)
+    if len(tail) == 2 and tail[1].isdigit():
+        return int(tail[1])
+    return 0
+
+
+def _base_name(pod: dict) -> str:
+    name = labels_of(pod).get("statefulset.kubernetes.io/pod-name") \
+        or name_of(pod)
+    tail = name.rsplit("-", 1)
+    if len(tail) == 2 and tail[1].isdigit():
+        return tail[0]
+    return name
+
+
+def _upsert(env: list, name: str, value: str) -> None:
+    for e in env:
+        if e.get("name") == name:
+            return  # user-set values win
+    env.append({"name": name, "value": value})

@@ -1,10 +1,11 @@
 """Multi-host bootstrap from platform-injected env.
 
 This is the in-image consumer of the control plane's rendezvous contract:
-the notebook webhook injects ``TPU_WORKER_ID`` (pod ordinal) and
-``TPU_WORKER_HOSTNAMES`` (headless-service DNS of every pod in the
-slice) into each pod of a multi-host Notebook
-(controlplane/webhook/tpu_inject.py). The reference has no equivalent —
+the webhook in ``controlplane/webhook/tpu_inject.py`` injects
+``TPU_WORKER_ID`` (pod ordinal) and ``TPU_WORKER_HOSTNAMES``
+(headless-service DNS of every pod in the slice) into each pod of a
+multi-host Notebook, and ``tests/test_notebook_controller.py`` asserts
+the round-trip through this module. The reference has no equivalent —
 its servers are single-pod (SURVEY.md §2.6, notebook_controller.go:409-412
 replicas in {0,1}) — so this module plus the webhook is new capability.
 """

@@ -1,0 +1,64 @@
+"""Model-FLOPs accounting and MFU.
+
+MFU = (model FLOPs/sec achieved) / (chip peak bf16 FLOPs/sec), with
+model FLOPs counted by the standard convention (PaLM appendix B /
+scaling-book): 6 FLOPs per matmul parameter per trained token
+(fwd 2 + bwd 4), plus the attention score/value matmuls
+(12·L·H·hd·T per token, halved for causal), and **not** counting
+rematerialization recompute — remat makes the hardware do more work,
+it does not make the model bigger.
+
+The reference platform has no FLOPs accounting anywhere (SURVEY.md §6:
+no published benchmarks); this module is what turns the north-star
+"≥40% MFU on a TPU slice" (BASELINE.md) into a measured number.
+"""
+
+from kubeflow_rm_tpu.models.llama import LlamaConfig
+
+# chip peak dense bf16 FLOPs/sec by device kind substring (public specs)
+_PEAK_BF16 = (
+    ("v6", 918e12),      # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # jax device_kind for v5e
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops(device) -> float | None:
+    """Peak dense bf16 FLOPs/sec for a jax device, or None if unknown."""
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def matmul_param_count(cfg: LlamaConfig) -> int:
+    """Parameters that take part in matmuls (excludes the embedding
+    gather and the vector norm gains)."""
+    L, D, V = cfg.n_layers, cfg.dim, cfg.vocab_size
+    H, KVH, hd, F = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.hidden_dim
+    per_layer = D * H * hd + 2 * D * KVH * hd + H * hd * D + 3 * D * F
+    return L * per_layer + D * V  # + lm_head
+
+
+def train_flops_per_token(cfg: LlamaConfig, seq_len: int,
+                          causal: bool = True) -> float:
+    """Model FLOPs per trained token for one fwd+bwd step."""
+    mat = 6.0 * matmul_param_count(cfg)
+    # score (QK^T) + weighted value (PV): 2·2·H·hd·T fwd, ×3 with bwd
+    attn = 12.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len
+    if causal:
+        attn /= 2.0
+    return mat + attn
+
+
+def mfu(tokens_per_sec: float, cfg: LlamaConfig, seq_len: int,
+        n_devices: int, peak_flops_per_device: float) -> float:
+    """Model FLOPs utilization in [0, 1]."""
+    achieved = tokens_per_sec * train_flops_per_token(cfg, seq_len)
+    return achieved / (n_devices * peak_flops_per_device)

@@ -1,0 +1,19 @@
+"""Shared fixtures for control-plane tests: fake clock + assembled stack."""
+
+from __future__ import annotations
+
+import datetime
+
+
+class FakeClock:
+    """Deterministic, manually-advanced clock injected into the apiserver
+    (the envtest suites' time control)."""
+
+    def __init__(self, start: str = "2026-01-01T00:00:00+00:00"):
+        self.now = datetime.datetime.fromisoformat(start)
+
+    def __call__(self) -> datetime.datetime:
+        return self.now
+
+    def advance(self, **timedelta_kwargs) -> None:
+        self.now = self.now + datetime.timedelta(**timedelta_kwargs)

@@ -1,0 +1,131 @@
+"""In-memory apiserver semantics (the envtest substrate, SURVEY.md §4)."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    make_object,
+    set_controller_reference,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import (
+    AdmissionDenied,
+    AlreadyExists,
+    APIServer,
+    Conflict,
+    NotFound,
+)
+
+
+@pytest.fixture
+def api():
+    a = APIServer()
+    a.ensure_namespace("ns1")
+    return a
+
+
+def cm(name, ns="ns1", **data):
+    obj = make_object("v1", "ConfigMap", name, ns)
+    obj["data"] = data
+    return obj
+
+
+def test_create_get_roundtrip(api):
+    created = api.create(cm("a", x="1"))
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"]
+    got = api.get("ConfigMap", "a", "ns1")
+    assert got["data"] == {"x": "1"}
+
+
+def test_create_requires_namespace(api):
+    with pytest.raises(NotFound):
+        api.create(cm("a", ns="missing"))
+
+
+def test_duplicate_create_rejected(api):
+    api.create(cm("a"))
+    with pytest.raises(AlreadyExists):
+        api.create(cm("a"))
+
+
+def test_update_conflict_on_stale_rv(api):
+    api.create(cm("a", x="1"))
+    first = api.get("ConfigMap", "a", "ns1")
+    second = api.get("ConfigMap", "a", "ns1")
+    first["data"]["x"] = "2"
+    api.update(first)
+    second["data"]["x"] = "3"
+    with pytest.raises(Conflict):
+        api.update(second)
+
+
+def test_patch_merges_and_deletes_keys(api):
+    api.create(cm("a", x="1", y="2"))
+    api.patch("ConfigMap", "a", {"data": {"x": "9", "y": None}}, "ns1")
+    assert api.get("ConfigMap", "a", "ns1")["data"] == {"x": "9"}
+
+
+def test_list_label_selector(api):
+    obj = cm("a")
+    obj["metadata"]["labels"] = {"app": "x"}
+    api.create(obj)
+    api.create(cm("b"))
+    got = api.list("ConfigMap", "ns1", {"matchLabels": {"app": "x"}})
+    assert [o["metadata"]["name"] for o in got] == ["a"]
+
+
+def test_owner_gc_cascades(api):
+    owner = api.create(cm("owner"))
+    child = cm("child")
+    set_controller_reference(owner, child)
+    api.create(child)
+    api.delete("ConfigMap", "owner", "ns1")
+    assert api.try_get("ConfigMap", "child", "ns1") is None
+
+
+def test_finalizers_defer_deletion(api):
+    obj = cm("a")
+    obj["metadata"]["finalizers"] = ["test/finalizer"]
+    api.create(obj)
+    api.delete("ConfigMap", "a", "ns1")
+    live = api.get("ConfigMap", "a", "ns1")
+    assert live["metadata"]["deletionTimestamp"]
+    live["metadata"]["finalizers"] = []
+    api.update(live)
+    assert api.try_get("ConfigMap", "a", "ns1") is None
+
+
+def test_namespace_delete_drains_contents(api):
+    api.create(cm("a"))
+    api.delete("Namespace", "ns1")
+    assert api.try_get("ConfigMap", "a", "ns1") is None
+
+
+def test_quota_rejects_over_limit_pod(api):
+    quota = make_object("v1", "ResourceQuota", "q", "ns1",
+                        spec={"hard": {"google.com/tpu": "4"}})
+    api.create(quota)
+
+    def pod(name, chips):
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "ns1"},
+            "spec": {"containers": [{
+                "name": "c", "image": "i",
+                "resources": {"limits": {"google.com/tpu": str(chips)}},
+            }]},
+        }
+
+    api.create(pod("p1", 4))
+    with pytest.raises(AdmissionDenied):
+        api.create(pod("p2", 1))
+    # non-TPU pods unaffected
+    api.create({"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "p3", "namespace": "ns1"},
+                "spec": {"containers": [{"name": "c", "image": "i"}]}})
+
+
+def test_events_recorded_and_queried(api):
+    obj = api.create(cm("a"))
+    api.record_event(obj, "Warning", "TestReason", "boom")
+    evs = api.events_for(obj)
+    assert len(evs) == 1 and evs[0]["reason"] == "TestReason"

@@ -1,0 +1,139 @@
+"""Idle culling with an injected clock + probe (culler.go:155-240,404-419),
+slice-aware: one idle notebook releases every host of its slice."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import annotations_of, deep_get
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import make_tpu_node
+from tests.cp_fixtures import FakeClock
+
+
+class FakeJupyter:
+    """Injectable /api/kernels probe."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.kernels = []
+
+    def activity(self, when=None, busy=False):
+        ts = (when or self.clock()).isoformat()
+        self.kernels = [{"execution_state": "busy" if busy else "idle",
+                         "last_activity": ts}]
+
+    def __call__(self, notebook, pod0):
+        return {"kernels": list(self.kernels), "terminals": []}
+
+
+@pytest.fixture
+def stack():
+    clock = FakeClock()
+    jupyter = FakeJupyter(clock)
+    api, mgr = make_control_plane(
+        clock=clock, enable_culling=True,
+        culler_config={"cull_idle_minutes": 60.0,
+                       "check_period_minutes": 1.0,
+                       "probe_fn": jupyter})
+    api.ensure_namespace("u")
+    for i in range(2):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    return api, mgr, clock, jupyter
+
+
+def test_idle_notebook_culled_whole_slice(stack):
+    api, mgr, clock, jupyter = stack
+    jupyter.activity()
+    api.create(make_notebook("idle", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    assert len(api.list("Pod", "u")) == 2
+
+    clock.advance(minutes=61)
+    mgr.run_until_idle()
+
+    nb = api.get(nb_api.KIND, "idle", "u")
+    ann = annotations_of(nb)
+    assert nb_api.STOP_ANNOTATION in ann
+    assert nb_api.LAST_ACTIVITY_ANNOTATION in ann
+    # the WHOLE slice scaled to zero — both hosts released
+    assert api.list("Pod", "u") == []
+    assert api.get("StatefulSet", "idle", "u")["spec"]["replicas"] == 0
+    evs = api.events_for(nb)
+    assert any(e["reason"] == "Culling" for e in evs)
+
+
+def test_recent_activity_prevents_culling(stack):
+    api, mgr, clock, jupyter = stack
+    jupyter.activity()
+    api.create(make_notebook("activenb", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+
+    clock.advance(minutes=45)
+    jupyter.activity()   # fresh activity at t=45
+    mgr.run_until_idle()
+    clock.advance(minutes=45)  # t=90, but idle only 45min
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "activenb", "u")
+    assert nb_api.STOP_ANNOTATION not in annotations_of(nb)
+
+    clock.advance(minutes=31)  # now 76min idle > 60
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "activenb", "u")
+    assert nb_api.STOP_ANNOTATION in annotations_of(nb)
+
+
+def test_busy_kernel_counts_as_activity_now(stack):
+    api, mgr, clock, jupyter = stack
+    jupyter.activity(busy=True)
+    api.create(make_notebook("busy", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    clock.advance(minutes=59)
+    mgr.run_until_idle()  # probe still reports busy -> last-activity = now
+    clock.advance(minutes=59)
+    jupyter.kernels = [{"execution_state": "idle",
+                        "last_activity": clock().isoformat()}]
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "busy", "u")
+    assert nb_api.STOP_ANNOTATION not in annotations_of(nb)
+
+
+def test_culling_exclusion_annotation(stack):
+    api, mgr, clock, jupyter = stack
+    jupyter.activity()
+    nb = make_notebook(
+        "keep", "u", accelerator_type="v5p-16",
+        annotations={nb_api.CULLING_EXCLUDE_ANNOTATION: "true"})
+    api.create(nb)
+    mgr.run_until_idle()
+    clock.advance(minutes=600)
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "keep", "u")
+    assert nb_api.STOP_ANNOTATION not in annotations_of(nb)
+    assert len(api.list("Pod", "u")) == 2
+
+
+def test_culled_notebook_restarts_with_state(stack):
+    """Stop->start preserves the CR and its PVC claims (workspace PVC is
+    the platform checkpoint story, SURVEY.md §5)."""
+    api, mgr, clock, jupyter = stack
+    jupyter.activity()
+    nb = make_notebook("restartable", "u", accelerator_type="v5p-16",
+                       pod_spec_extra={"volumes": [{
+                           "name": "workspace",
+                           "persistentVolumeClaim": {"claimName": "ws"}}]})
+    api.create(nb)
+    mgr.run_until_idle()
+    clock.advance(minutes=61)
+    mgr.run_until_idle()
+    assert api.list("Pod", "u") == []
+
+    nb = api.get(nb_api.KIND, "restartable", "u")
+    del nb["metadata"]["annotations"][nb_api.STOP_ANNOTATION]
+    api.update(nb)
+    mgr.run_until_idle()
+    pods = api.list("Pod", "u")
+    assert len(pods) == 2
+    vols = deep_get(pods[0], "spec", "volumes", default=[])
+    assert any(deep_get(v, "persistentVolumeClaim", "claimName") == "ws"
+               for v in vols)

@@ -1,0 +1,79 @@
+"""parallel.distributed: the in-image consumer of the webhook's
+rendezvous env (VERDICT r1 flagged this module as untested)."""
+
+import jax
+
+from kubeflow_rm_tpu.parallel.distributed import (
+    DEFAULT_COORDINATOR_PORT,
+    TpuEnv,
+    initialize,
+    tpu_env,
+)
+
+
+def test_tpu_env_defaults_single_host():
+    te = tpu_env({})
+    assert te.worker_id == 0
+    assert te.worker_hostnames == []
+    assert te.num_hosts == 1
+    assert not te.is_multihost
+    assert te.accelerator_type is None
+
+
+def test_tpu_env_parses_webhook_injection():
+    env = {
+        "TPU_WORKER_ID": "3",
+        "TPU_WORKER_HOSTNAMES": ",".join(
+            f"nb-{i}.nb-workers.u.svc.cluster.local" for i in range(4)),
+        "TPU_ACCELERATOR_TYPE": "v5litepod-16",
+        "TPU_TOPOLOGY": "4x4",
+    }
+    te = tpu_env(env)
+    assert te.worker_id == 3
+    assert te.num_hosts == 4
+    assert te.is_multihost
+    assert te.worker_hostnames[0] == "nb-0.nb-workers.u.svc.cluster.local"
+    assert te.accelerator_type == "v5litepod-16"
+    assert te.topology == "4x4"
+
+
+def test_tpu_env_ignores_empty_hostname_entries():
+    te = tpu_env({"TPU_WORKER_HOSTNAMES": "a,,b,"})
+    assert te.worker_hostnames == ["a", "b"]
+    assert te.num_hosts == 2
+
+
+def test_initialize_single_host_is_noop(monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    te = initialize({})
+    assert calls == []
+    assert te.num_hosts == 1
+
+
+def test_initialize_multihost_uses_worker0_as_coordinator(monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    env = {
+        "TPU_WORKER_ID": "1",
+        "TPU_WORKER_HOSTNAMES": "w0.svc,w1.svc",
+    }
+    initialize(env)
+    assert calls == [{
+        "coordinator_address": f"w0.svc:{DEFAULT_COORDINATOR_PORT}",
+        "num_processes": 2,
+        "process_id": 1,
+    }]
+
+
+def test_tpuenv_is_frozen_dataclass():
+    te = TpuEnv(worker_id=0, worker_hostnames=[], accelerator_type=None,
+                topology=None)
+    try:
+        te.worker_id = 1
+        raised = False
+    except Exception:
+        raised = True
+    assert raised

@@ -1,0 +1,209 @@
+"""Notebook reconciler + webhooks: the #1 call stack (SURVEY.md §3.1),
+asserted against the full rendered object graph — single-host and
+multi-host v5p-16 — the way the reference's envtest suite does
+(notebook_controller_test.go)."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    deep_get,
+    make_object,
+    set_annotation,
+)
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.apiserver import AdmissionDenied, Invalid
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import make_tpu_node
+
+
+@pytest.fixture
+def stack():
+    api, mgr = make_control_plane()
+    api.ensure_namespace("user1")
+    for i in range(4):
+        api.create(make_tpu_node(f"v5p-{i}", "v5p-16"))
+    api.create(make_tpu_node("v5e-0", "v5litepod-8"))
+    return api, mgr
+
+
+def spawn(api, mgr, nb):
+    api.create(nb)
+    mgr.run_until_idle()
+    return api.get(nb_api.KIND, nb["metadata"]["name"],
+                   nb["metadata"]["namespace"])
+
+
+def test_cpu_notebook_renders_single_replica(stack):
+    api, mgr = stack
+    spawn(api, mgr, make_notebook("plain", "user1"))
+    sts = api.get("StatefulSet", "plain", "user1")
+    assert sts["spec"]["replicas"] == 1
+    assert sts["spec"]["podManagementPolicy"] == "OrderedReady"
+    tmpl_spec = sts["spec"]["template"]["spec"]
+    assert "nodeSelector" not in tmpl_spec
+    env = {e["name"]: e["value"]
+           for e in tmpl_spec["containers"][0]["env"]}
+    assert env["NB_PREFIX"] == "/notebook/user1/plain"
+    # UI service: 80 -> 8888 pinned to pod 0
+    svc = api.get("Service", "plain", "user1")
+    assert svc["spec"]["ports"][0]["port"] == 80
+    assert svc["spec"]["ports"][0]["targetPort"] == 8888
+    assert svc["spec"]["selector"] == {
+        "statefulset.kubernetes.io/pod-name": "plain-0"}
+
+
+def test_multihost_tpu_notebook_full_object_graph(stack):
+    api, mgr = stack
+    nb = spawn(api, mgr,
+               make_notebook("big", "user1", accelerator_type="v5p-16"))
+    sts = api.get("StatefulSet", "big", "user1")
+    # v5p-16 = 8 chips, 4 per host, 2 hosts
+    assert sts["spec"]["replicas"] == 2
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    assert sts["spec"]["serviceName"] == "big-workers"
+    tmpl = sts["spec"]["template"]
+    c0 = tmpl["spec"]["containers"][0]
+    assert c0["resources"]["limits"]["google.com/tpu"] == "4"
+    assert tmpl["spec"]["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+        "cloud.google.com/gke-tpu-topology": "2x2x2",
+    }
+    # headless service exists with clusterIP None
+    workers = api.get("Service", "big-workers", "user1")
+    assert workers["spec"]["clusterIP"] == "None"
+    # both pods scheduled on distinct TPU nodes and Running
+    pods = api.list("Pod", "user1",
+                    {"matchLabels": {nb_api.NOTEBOOK_NAME_LABEL: "big"}})
+    assert sorted(p["metadata"]["name"] for p in pods) == ["big-0", "big-1"]
+    nodes = {deep_get(p, "spec", "nodeName") for p in pods}
+    assert len(nodes) == 2
+    assert all(deep_get(p, "status", "phase") == "Running" for p in pods)
+    # notebook status mirrors pod 0 (ref :274-349)
+    assert nb["status"]["readyReplicas"] == 2
+    assert {"type": "Ready", "status": "True"} in nb["status"]["conditions"]
+    assert "running" in nb["status"]["containerState"]
+
+
+def test_webhook_env_round_trips_through_tpu_env(stack):
+    api, mgr = stack
+    spawn(api, mgr, make_notebook("rt", "user1", accelerator_type="v5p-16"))
+    from kubeflow_rm_tpu.parallel.distributed import tpu_env
+
+    for ordinal in (0, 1):
+        pod = api.get("Pod", f"rt-{ordinal}", "user1")
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        te = tpu_env(env)
+        assert te.worker_id == ordinal
+        assert te.num_hosts == 2
+        assert te.is_multihost
+        assert te.accelerator_type == "v5p-16"
+        assert te.topology == "2x2x2"
+        assert te.worker_hostnames[ordinal] == \
+            f"rt-{ordinal}.rt-workers.user1.svc.cluster.local"
+        # /dev/shm memory volume injected (form.py:264-276 analog)
+        mounts = pod["spec"]["containers"][0]["volumeMounts"]
+        assert any(m["mountPath"] == "/dev/shm" for m in mounts)
+
+
+def test_stop_annotation_scales_slice_to_zero_and_back(stack):
+    api, mgr = stack
+    nb = spawn(api, mgr,
+               make_notebook("s", "user1", accelerator_type="v5litepod-8"))
+    assert len(api.list("Pod", "user1")) == 1
+    set_annotation(nb, nb_api.STOP_ANNOTATION, "2026-07-29T00:00:00")
+    api.update(nb)
+    mgr.run_until_idle()
+    assert api.get("StatefulSet", "s", "user1")["spec"]["replicas"] == 0
+    assert api.list("Pod", "user1") == []
+    nb = api.get(nb_api.KIND, "s", "user1")
+    assert nb["status"]["readyReplicas"] == 0
+    # restart: remove the annotation
+    del nb["metadata"]["annotations"][nb_api.STOP_ANNOTATION]
+    api.update(nb)
+    mgr.run_until_idle()
+    assert api.get("StatefulSet", "s", "user1")["spec"]["replicas"] == 1
+    assert len(api.list("Pod", "user1")) == 1
+
+
+def test_reconciliation_lock_injected_and_released(stack):
+    api, mgr = stack
+    created = api.create(make_notebook("locked", "user1"))
+    # webhook stamped the lock at admission (notebook_webhook.go:63-74)
+    from kubeflow_rm_tpu.controlplane.webhook.notebook import LOCK_VALUE
+    assert created["metadata"]["annotations"][nb_api.STOP_ANNOTATION] == \
+        LOCK_VALUE
+    mgr.run_until_idle()
+    # release controller removed it; slice came up
+    nb = api.get(nb_api.KIND, "locked", "user1")
+    assert nb_api.STOP_ANNOTATION not in (
+        nb["metadata"].get("annotations") or {})
+    assert api.get("StatefulSet", "locked", "user1")["spec"]["replicas"] == 1
+
+
+def test_no_restart_guard_blocks_running_spec_change(stack):
+    api, mgr = stack
+    nb = spawn(api, mgr, make_notebook("g", "user1"))
+    nb["spec"]["template"]["spec"]["containers"][0]["image"] = "other:1"
+    with pytest.raises(AdmissionDenied):
+        api.update(nb)
+    # explicit opt-in passes (notebook-restart annotation)
+    set_annotation(nb, nb_api.RESTART_ANNOTATION, "true")
+    api.update(nb)
+
+
+def test_stopped_notebook_spec_change_allowed(stack):
+    api, mgr = stack
+    nb = spawn(api, mgr, make_notebook("st", "user1"))
+    set_annotation(nb, nb_api.STOP_ANNOTATION, "ts")
+    nb = api.update(nb)
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "st", "user1")
+    nb["spec"]["template"]["spec"]["containers"][0]["image"] = "other:2"
+    api.update(nb)  # no AdmissionDenied
+
+
+def test_image_resolution_from_configmap(stack):
+    api, mgr = stack
+    api.ensure_namespace("kubeflow")
+    images = make_object("v1", "ConfigMap", "notebook-images", "kubeflow")
+    images["data"] = {"jupyter-jax": "gcr.io/kubeflow/jupyter-jax:v1.2"}
+    api.create(images)
+    created = api.create(make_notebook("imw", "user1", image="jupyter-jax"))
+    c0 = deep_get(created, "spec", "template", "spec", "containers", 0)
+    assert c0["image"] == "gcr.io/kubeflow/jupyter-jax:v1.2"
+
+
+def test_unschedulable_slice_surfaces_event_on_notebook(stack):
+    api, mgr = stack
+    # ask for more slices than the inventory has: v5litepod-16 needs 4
+    # hosts of 4 chips with the v5e-lite 4x4 topology label — none exist
+    spawn(api, mgr,
+          make_notebook("land", "user1", accelerator_type="v5litepod-16"))
+    pods = api.list("Pod", "user1",
+                    {"matchLabels": {nb_api.NOTEBOOK_NAME_LABEL: "land"}})
+    assert pods and all(
+        deep_get(p, "status", "phase") == "Pending" for p in pods)
+    nb = api.get(nb_api.KIND, "land", "user1")
+    evs = api.events_for(nb)
+    assert any(e["reason"] == "FailedScheduling" for e in evs), evs
+
+
+def test_invalid_accelerator_type_rejected(stack):
+    api, _ = stack
+    with pytest.raises(Invalid):
+        api.create(make_notebook("bad", "user1",
+                                 accelerator_type="v99-frobnitz"))
+
+
+def test_notebook_delete_garbage_collects_children(stack):
+    api, mgr = stack
+    spawn(api, mgr, make_notebook("gone", "user1",
+                                  accelerator_type="v5litepod-8"))
+    api.delete(nb_api.KIND, "gone", "user1")
+    mgr.run_until_idle()
+    assert api.try_get("StatefulSet", "gone", "user1") is None
+    assert api.try_get("Service", "gone", "user1") is None
+    assert api.try_get("Service", "gone-workers", "user1") is None
+    assert api.list("Pod", "user1") == []

@@ -1,0 +1,170 @@
+"""PodDefault merge engine: selection, merge semantics, conflict
+rejection (admission-webhook/main.go:72-560)."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get
+from kubeflow_rm_tpu.controlplane.api.poddefault import make_poddefault
+from kubeflow_rm_tpu.controlplane.apiserver import AdmissionDenied
+
+
+@pytest.fixture
+def api():
+    api, _ = make_control_plane()
+    api.ensure_namespace("ns")
+    return api
+
+
+def pod(name="p", labels=None, env=None, volumes=None, mounts=None):
+    c = {"name": "main", "image": "img"}
+    if env:
+        c["env"] = env
+    if mounts:
+        c["volumeMounts"] = mounts
+    spec = {"containers": [c]}
+    if volumes:
+        spec["volumes"] = volumes
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "ns",
+                         "labels": labels or {}},
+            "spec": spec}
+
+
+def test_selected_poddefault_merges_env_volumes_sidecars(api):
+    api.create(make_poddefault(
+        "gcs-access", "ns",
+        selector={"matchLabels": {"add-gcs": "true"}},
+        env=[{"name": "GOOGLE_CLOUD_PROJECT", "value": "proj"}],
+        volumes=[{"name": "cache", "emptyDir": {}}],
+        volumeMounts=[{"name": "cache", "mountPath": "/cache"}],
+        sidecars=[{"name": "proxy", "image": "proxy:1"}],
+        tolerations=[{"key": "tpu", "operator": "Exists"}],
+    ))
+    created = api.create(pod(labels={"add-gcs": "true"}))
+    c0 = created["spec"]["containers"][0]
+    assert {"name": "GOOGLE_CLOUD_PROJECT", "value": "proj"} in c0["env"]
+    assert {"name": "cache", "mountPath": "/cache"} in c0["volumeMounts"]
+    assert any(v["name"] == "cache" for v in created["spec"]["volumes"])
+    assert any(c["name"] == "proxy" for c in created["spec"]["containers"])
+    assert created["spec"]["tolerations"] == [
+        {"key": "tpu", "operator": "Exists"}]
+    # applied marker annotation (ref :551-559)
+    assert any(k.startswith(pd_api.APPLIED_ANNOTATION_PREFIX)
+               for k in created["metadata"]["annotations"])
+
+
+def test_unselected_pod_untouched(api):
+    api.create(make_poddefault(
+        "x", "ns", selector={"matchLabels": {"x": "1"}},
+        env=[{"name": "A", "value": "1"}]))
+    created = api.create(pod())
+    assert "env" not in created["spec"]["containers"][0]
+
+
+def test_env_conflict_between_poddefaults_rejected(api):
+    api.create(make_poddefault(
+        "a", "ns", selector={"matchLabels": {"m": "1"}},
+        env=[{"name": "SHARED", "value": "from-a"}]))
+    api.create(make_poddefault(
+        "b", "ns", selector={"matchLabels": {"m": "1"}},
+        env=[{"name": "SHARED", "value": "from-b"}]))
+    with pytest.raises(AdmissionDenied):
+        api.create(pod(labels={"m": "1"}))
+
+
+def test_identical_env_across_poddefaults_ok(api):
+    api.create(make_poddefault(
+        "a", "ns", selector={"matchLabels": {"m": "1"}},
+        env=[{"name": "SHARED", "value": "same"}]))
+    api.create(make_poddefault(
+        "b", "ns", selector={"matchLabels": {"m": "1"}},
+        env=[{"name": "SHARED", "value": "same"}]))
+    created = api.create(pod(labels={"m": "1"}))
+    envs = [e for e in created["spec"]["containers"][0]["env"]
+            if e["name"] == "SHARED"]
+    assert envs == [{"name": "SHARED", "value": "same"}]
+
+
+def test_mountpath_conflict_with_pod_rejected(api):
+    api.create(make_poddefault(
+        "m", "ns", selector={"matchLabels": {"m": "1"}},
+        volumes=[{"name": "other", "emptyDir": {}}],
+        volumeMounts=[{"name": "other", "mountPath": "/data"}]))
+    p = pod(labels={"m": "1"},
+            volumes=[{"name": "mine", "emptyDir": {}}],
+            mounts=[{"name": "mine", "mountPath": "/data"}])
+    with pytest.raises(AdmissionDenied):
+        api.create(p)
+
+
+def test_exclude_annotation_skips_merge(api):
+    api.create(make_poddefault(
+        "e", "ns", selector={"matchLabels": {"m": "1"}},
+        env=[{"name": "A", "value": "1"}]))
+    p = pod(labels={"m": "1"})
+    p["metadata"]["annotations"] = {pd_api.EXCLUDE_ANNOTATION: "true"}
+    created = api.create(p)
+    assert "env" not in created["spec"]["containers"][0]
+
+
+def test_pod_existing_env_wins_over_poddefault(api):
+    api.create(make_poddefault(
+        "w", "ns", selector={"matchLabels": {"m": "1"}},
+        env=[{"name": "KEEP", "value": "pd"}]))
+    # identical name+value from the pod itself is not a conflict and is
+    # not duplicated
+    p = pod(labels={"m": "1"}, env=[{"name": "KEEP", "value": "pd"}])
+    created = api.create(p)
+    assert created["spec"]["containers"][0]["env"] == [
+        {"name": "KEEP", "value": "pd"}]
+
+
+def test_serviceaccount_and_command_only_fill_defaults(api):
+    api.create(make_poddefault(
+        "sa", "ns", selector={"matchLabels": {"m": "1"}},
+        serviceAccountName="editor", command=["run.sh"], args=["--x"]))
+    created = api.create(pod(labels={"m": "1"}))
+    assert created["spec"]["serviceAccountName"] == "editor"
+    assert created["spec"]["containers"][0]["command"] == ["run.sh"]
+    p2 = pod("p2", labels={"m": "1"})
+    p2["spec"]["serviceAccountName"] = "custom"
+    p2["spec"]["containers"][0]["command"] = ["mine.sh"]
+    created2 = api.create(p2)
+    assert created2["spec"]["serviceAccountName"] == "custom"
+    assert created2["spec"]["containers"][0]["command"] == ["mine.sh"]
+
+
+def test_poddefault_requires_selector(api):
+    from kubeflow_rm_tpu.controlplane.apiserver import Invalid
+    bad = make_poddefault("bad", "ns", selector={"matchLabels": {}})
+    del bad["spec"]["selector"]
+    with pytest.raises(Invalid):
+        api.create(bad)
+
+
+def test_poddefault_composes_with_tpu_injection(api):
+    """PodDefault merge runs before TPU injection; both apply cleanly to
+    a slice worker pod (the designated TPU_WORKER_* seam, SURVEY §2.6)."""
+    from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+
+    api.create(make_poddefault(
+        "tokens", "ns", selector={"matchLabels": {"team": "ml"}},
+        env=[{"name": "HF_TOKEN", "value": "secret"}]))
+    p = pod(labels={"team": "ml",
+                    nb_api.TPU_ACCELERATOR_LABEL: "v5litepod-16",
+                    "statefulset.kubernetes.io/pod-name": "nb-2"})
+    p["spec"]["subdomain"] = "nb-workers"
+    p["spec"]["nodeSelector"] = {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "4x4"}
+    api.quota_enforcement = False
+    created = api.create(p)
+    env = {e["name"]: e.get("value")
+           for e in created["spec"]["containers"][0]["env"]}
+    assert env["HF_TOKEN"] == "secret"
+    assert env["TPU_WORKER_ID"] == "2"
+    assert env["TPU_WORKER_HOSTNAMES"].split(",")[2] == \
+        "nb-2.nb-workers.ns.svc.cluster.local"
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4

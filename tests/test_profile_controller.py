@@ -1,0 +1,117 @@
+"""Profile controller: namespace onboarding + TPU-chip quota
+(profile_controller.go:105-335, quota :252-281)."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api import profile as profile_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.api.profile import make_profile
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import make_tpu_node
+
+
+@pytest.fixture
+def stack():
+    api, mgr = make_control_plane()
+    return api, mgr
+
+
+def test_profile_provisions_namespace_rbac_quota(stack):
+    api, mgr = stack
+    api.create(make_profile("bob", "bob@corp.com",
+                            quota_hard={"google.com/tpu": "8",
+                                        "pods": "20"}))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+
+    ns = api.get("Namespace", "bob")
+    assert ns["metadata"]["annotations"]["owner"] == "bob@corp.com"
+    for sa in (profile_api.DEFAULT_EDITOR, profile_api.DEFAULT_VIEWER):
+        assert api.get("ServiceAccount", sa, "bob")
+    admin = api.get("RoleBinding", "namespaceAdmin", "bob")
+    assert admin["subjects"][0]["name"] == "bob@corp.com"
+    editor_rb = api.get("RoleBinding", "default-editor", "bob")
+    assert editor_rb["roleRef"]["name"] == "kubeflow-edit"
+    quota = api.get("ResourceQuota", profile_api.QUOTA_NAME, "bob")
+    assert quota["spec"]["hard"]["google.com/tpu"] == "8"
+
+
+def test_quota_update_and_removal_follow_spec(stack):
+    api, mgr = stack
+    api.create(make_profile("carol", "carol@corp.com",
+                            quota_hard={"google.com/tpu": "4"}))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    prof = api.get("Profile", "carol")
+    prof["spec"]["resourceQuotaSpec"] = {"hard": {"google.com/tpu": "16"}}
+    api.update(prof)
+    mgr.run_until_idle()
+    assert api.get("ResourceQuota", profile_api.QUOTA_NAME,
+                   "carol")["spec"]["hard"]["google.com/tpu"] == "16"
+    # unset -> quota deleted (ref :276-281)
+    prof = api.get("Profile", "carol")
+    del prof["spec"]["resourceQuotaSpec"]
+    api.update(prof)
+    mgr.run_until_idle()
+    assert api.try_get("ResourceQuota", profile_api.QUOTA_NAME,
+                       "carol") is None
+
+
+def test_quota_rejects_over_chip_notebook(stack):
+    """A Profile quota of 4 chips must reject a v5p-16 slice (8 chips):
+    the whole point of per-namespace TPU quotas (SURVEY seam :252-281)."""
+    api, mgr = stack
+    for i in range(4):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    api.create(make_tpu_node("n8", "v5p-8"))  # node pool for v5p-8 slices
+    api.create(make_profile("dave", "dave@corp.com",
+                            quota_hard={"google.com/tpu": "4"}))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+
+    api.create(make_notebook("toobig", "dave", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    # STS exists but pod creation was quota-denied: first pod (4 chips)
+    # fits, second exceeds the namespace's 4-chip budget
+    pods = api.list("Pod", "dave")
+    assert len(pods) < 2
+    sts = api.get("StatefulSet", "toobig", "dave")
+    evs = api.events_for(sts)
+    assert any(e["reason"] == "FailedCreate" and "quota" in e["message"]
+               for e in evs), evs
+
+    # a right-sized notebook in the same namespace is fine
+    api.delete("Notebook", "toobig", "dave")
+    mgr.run_until_idle()
+    api.create(make_notebook("fits", "dave", accelerator_type="v5p-8"))
+    mgr.run_until_idle()
+    pods = api.list("Pod", "dave")
+    assert [p["metadata"]["name"] for p in pods] == ["fits-0"]
+    assert deep_get(pods[0], "status", "phase") == "Running"
+
+
+def test_profile_delete_cascades_namespace(stack):
+    api, mgr = stack
+    api.create(make_profile("eve", "eve@corp.com"))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    assert api.get("Namespace", "eve")
+    api.delete("Profile", "eve")
+    mgr.run_until_idle()
+    assert api.try_get("Namespace", "eve") is None
+    assert api.try_get("ServiceAccount", "default-editor", "eve") is None
+
+
+def test_workload_identity_plugin_annotates_editor_sa(stack):
+    api, mgr = stack
+    api.create(make_profile(
+        "frank", "frank@corp.com",
+        plugins=[{"kind": "WorkloadIdentity",
+                  "spec": {"gcpServiceAccount":
+                           "train@proj.iam.gserviceaccount.com"}}]))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    sa = api.get("ServiceAccount", "default-editor", "frank")
+    assert sa["metadata"]["annotations"]["iam.gke.io/gcp-service-account"] \
+        == "train@proj.iam.gserviceaccount.com"

@@ -1,0 +1,111 @@
+"""Tensorboard + PVCViewer satellites (tensorboard_controller.go:167-300,
+pvcviewer_controller.go:96-148)."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+from kubeflow_rm_tpu.controlplane.controllers.tensorboard import (
+    make_tensorboard,
+)
+from kubeflow_rm_tpu.controlplane.controllers.pvcviewer import make_pvcviewer
+
+
+@pytest.fixture
+def stack():
+    api, mgr = make_control_plane()
+    api.ensure_namespace("ns")
+    return api, mgr
+
+
+def make_pvc(api, name, modes=("ReadWriteOnce",)):
+    pvc = make_object("v1", "PersistentVolumeClaim", name, "ns",
+                      spec={"accessModes": list(modes),
+                            "resources": {"requests": {"storage": "10Gi"}}})
+    return api.create(pvc)
+
+
+def test_tensorboard_pvc_path_renders_mount(stack):
+    api, mgr = stack
+    make_pvc(api, "logs-pvc")
+    api.create(make_tensorboard("tb1", "ns", "pvc://logs-pvc/run1"))
+    mgr.run_until_idle()
+    deploy = api.get("Deployment", "tb1", "ns")
+    spec = deep_get(deploy, "spec", "template", "spec")
+    c0 = spec["containers"][0]
+    assert "--logdir" in c0["args"]
+    assert c0["args"][c0["args"].index("--logdir") + 1] == \
+        "/tensorboard_logs/run1"
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+        "logs-pvc"
+    svc = api.get("Service", "tb1", "ns")
+    assert svc["spec"]["ports"][0]["targetPort"] == 6006
+    tb = api.get("Tensorboard", "tb1", "ns")
+    assert tb["status"]["readyReplicas"] == 1
+
+
+def test_tensorboard_gcs_path_uses_workload_identity(stack):
+    api, mgr = stack
+    api.create(make_tensorboard("tb2", "ns", "gs://bucket/experiments"))
+    mgr.run_until_idle()
+    deploy = api.get("Deployment", "tb2", "ns")
+    spec = deep_get(deploy, "spec", "template", "spec")
+    c0 = spec["containers"][0]
+    assert c0["args"][c0["args"].index("--logdir") + 1] == \
+        "gs://bucket/experiments"
+    # TPU-native: workload-identity SA, no GCP key secret volume
+    assert spec["serviceAccountName"] == "default-editor"
+    assert "volumes" not in spec
+
+
+def test_tensorboard_rwo_pins_to_mounting_node(stack):
+    api, mgr = stack
+    make_pvc(api, "rwo-pvc")
+    # a running pod already mounts the RWO pvc on node-a
+    api.create(make_object("v1", "Node", "node-a"))
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "user-pod", "namespace": "ns"},
+        "spec": {"nodeName": "node-a",
+                 "containers": [{"name": "c", "image": "i"}],
+                 "volumes": [{"name": "w", "persistentVolumeClaim":
+                              {"claimName": "rwo-pvc"}}]},
+    }
+    api.quota_enforcement = False
+    created = api.create(pod)
+    created["status"] = {"phase": "Running"}
+    api.update_status(created)
+
+    api.create(make_tensorboard("tb3", "ns", "pvc://rwo-pvc/x"))
+    mgr.run_until_idle()
+    deploy = api.get("Deployment", "tb3", "ns")
+    assert deep_get(deploy, "spec", "template", "spec", "nodeName") == \
+        "node-a"
+
+
+def test_pvcviewer_renders_filebrowser(stack):
+    api, mgr = stack
+    make_pvc(api, "data", modes=("ReadWriteMany",))
+    api.create(make_pvcviewer("v1", "ns", "data"))
+    mgr.run_until_idle()
+    deploy = api.get("Deployment", "v1-pvcviewer", "ns")
+    spec = deep_get(deploy, "spec", "template", "spec")
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "data"
+    assert "--baseurl" in spec["containers"][0]["args"]
+    viewer = api.get("PVCViewer", "v1", "ns")
+    assert viewer["status"]["ready"] is True
+    svc = api.get("Service", "v1-pvcviewer", "ns")
+    assert svc["spec"]["ports"][0]["targetPort"] == 8080
+
+
+def test_pvcviewer_delete_cascades(stack):
+    api, mgr = stack
+    make_pvc(api, "d2")
+    api.create(make_pvcviewer("v2", "ns", "d2"))
+    mgr.run_until_idle()
+    api.delete("PVCViewer", "v2", "ns")
+    mgr.run_until_idle()
+    assert api.try_get("Deployment", "v2-pvcviewer", "ns") is None
+    assert api.try_get("Service", "v2-pvcviewer", "ns") is None
+    # the PVC itself is NOT owned by the viewer and survives
+    assert api.get("PersistentVolumeClaim", "d2", "ns")

@@ -40,9 +40,11 @@ def main() -> None:
     on_tpu = platform == "tpu"
 
     if on_tpu:
-        # ~1.2B params; bf16 params keep params+grads+adam under a v5e's
-        # 16 GiB HBM (fp32 master + moments would not fit)
-        model = LlamaConfig.bench_1b(param_dtype=jnp.bfloat16)
+        # ~1.2B params; bf16 params + full remat keep state (~7 G) plus
+        # live activations under a v5e's 16 GiB HBM (fp32 master/moments
+        # or the save-dots policy would not fit)
+        model = LlamaConfig.bench_1b(param_dtype=jnp.bfloat16,
+                                     remat_policy="full")
         batch, steps, warmup = 4, 10, 2
     else:
         model = LlamaConfig.tiny()
@@ -62,14 +64,17 @@ def main() -> None:
     host_batch = {"tokens": tok, "labels": labels}
     dev_batch = shard_batch(host_batch, mesh)  # device-resident once
 
+    # NOTE: sync via device_get, not block_until_ready — a host fetch
+    # cannot return before the computation lands, while block_until_ready
+    # has been observed to return immediately through the axon tunnel.
     for _ in range(warmup):
         state, metrics = step(state, dev_batch)
-    jax.block_until_ready(metrics["loss"])
+    float(jax.device_get(metrics["loss"]))
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, dev_batch)
-    jax.block_until_ready(metrics["loss"])
+    final_loss = float(jax.device_get(metrics["loss"]))
     dt = time.perf_counter() - t0
 
     step_time = dt / steps
@@ -95,7 +100,7 @@ def main() -> None:
         "model": "llama-bench1b" if on_tpu else "llama-tiny(cpu-fallback)",
         "batch": batch,
         "seq_len": seq_len,
-        "final_loss": round(float(metrics["loss"]), 4),
+        "final_loss": round(final_loss, 4),
     }
     print(json.dumps(out))
 

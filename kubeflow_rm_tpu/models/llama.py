@@ -56,6 +56,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # "dots": save matmul outputs (fastest bwd, ~L× activation memory);
+    # "full": save only the scan carry and recompute the block (O(1)
+    # live layers — what a 16 GiB v5e needs for the 1B bench config).
+    remat_policy: str = "dots"
 
     @property
     def head_dim(self) -> int:
@@ -205,10 +209,15 @@ def forward(
 
     block = partial(_block, cfg)
     if cfg.remat:
-        block = jax.checkpoint(
-            block,
-            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-        )
+        policies = {
+            "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            "full": jax.checkpoint_policies.nothing_saveable,
+        }
+        if cfg.remat_policy not in policies:
+            raise ValueError(
+                f"remat_policy must be one of {sorted(policies)}, "
+                f"got {cfg.remat_policy!r}")
+        block = jax.checkpoint(block, policy=policies[cfg.remat_policy])
 
     def scan_body(x, layer):
         return block(x, layer, cos, sin, positions, segments), None

@@ -1,3 +1,5 @@
+from kubeflow_rm_tpu.training.checkpoint import Checkpointer, abstract_state
+from kubeflow_rm_tpu.training.loop import LoopConfig, LoopMetrics, fit
 from kubeflow_rm_tpu.training.train import (
     TrainConfig,
     TrainState,
@@ -5,4 +7,14 @@ from kubeflow_rm_tpu.training.train import (
     make_train_step,
 )
 
-__all__ = ["TrainConfig", "TrainState", "init_train_state", "make_train_step"]
+__all__ = [
+    "Checkpointer",
+    "LoopConfig",
+    "LoopMetrics",
+    "TrainConfig",
+    "TrainState",
+    "abstract_state",
+    "fit",
+    "init_train_state",
+    "make_train_step",
+]

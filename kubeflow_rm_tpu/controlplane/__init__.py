@@ -69,9 +69,14 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     PodDefaultWebhook(api).register()
     TpuInjectWebhook(api).register()
 
+    from kubeflow_rm_tpu.controlplane.controllers.authcompanion import (
+        AuthCompanionController,
+    )
+
     manager = Manager(api)
     manager.add(NotebookController())
     manager.add(LockReleaseController())
+    manager.add(AuthCompanionController())
     manager.add(StatefulSetController(auto_ready=auto_ready))
     manager.add(DeploymentController(auto_ready=auto_ready))
     manager.add(ProfileController())

@@ -308,6 +308,61 @@ class APIServer:
             and deep_get(e, "involvedObject", "kind") == involved["kind"]
         ]
 
+    # ---- SubjectAccessReview (kube-apiserver authorization) ----------
+    READ_VERBS = frozenset({"get", "list", "watch"})
+
+    def access_review(self, user: str | None, verb: str, resource: str,
+                      namespace: str | None = None) -> bool:
+        """Evaluate RBAC the way a SubjectAccessReview does: the web
+        apps' authz decorator submits one per request (reference:
+        ``crud_backend/authz.py:46-80`` builds a V1SubjectAccessReview;
+        here the apiserver evaluates the RoleBindings the profile
+        controller / KFAM wrote instead of delegating to kube).
+
+        Semantics covered: cluster-admin ClusterRoleBindings grant
+        everything; namespace RoleBindings to admin/edit ClusterRoles
+        grant all verbs in that namespace; view grants read verbs.
+        ``resource`` participates only through the role tier — the
+        kubeflow-{admin,edit,view} aggregated roles cover every kind
+        the platform serves, matching the reference's deployment.
+        """
+        if user is None:
+            return False
+        for crb in self.list("ClusterRoleBinding"):
+            if self._binding_has_subject(crb, user, None) and \
+                    deep_get(crb, "roleRef", "name") == "cluster-admin":
+                return True
+        if namespace is None:
+            return False
+        for rb in self.list("RoleBinding", namespace):
+            if not self._binding_has_subject(rb, user, namespace):
+                continue
+            role = deep_get(rb, "roleRef", "name") or ""
+            if role in ("kubeflow-admin", "kubeflow-edit", "admin", "edit"):
+                return True
+            if role in ("kubeflow-view", "view") and \
+                    verb in self.READ_VERBS:
+                return True
+        return False
+
+    @staticmethod
+    def _binding_has_subject(binding: dict, user: str,
+                             binding_ns: str | None) -> bool:
+        """User subjects match the identity-header name; ServiceAccount
+        subjects ONLY match the ``system:serviceaccount:<ns>:<name>``
+        rendering (as a real SubjectAccessReview would) — a request
+        whose userid header is literally "default-editor" must NOT
+        inherit that SA's grants."""
+        for s in binding.get("subjects") or []:
+            kind, name = s.get("kind"), s.get("name")
+            if kind == "User" and name == user:
+                return True
+            if kind == "ServiceAccount":
+                sa_ns = s.get("namespace") or binding_ns
+                if user == f"system:serviceaccount:{sa_ns}:{name}":
+                    return True
+        return False
+
     # ---- ResourceQuota enforcement (kube-apiserver built-in) ---------
     def _enforce_quota(self, pod: dict) -> None:
         ns = namespace_of(pod)

@@ -51,14 +51,17 @@ def default_probe(notebook: dict, pod0: dict | None):
     ns = notebook["metadata"]["namespace"]
     name = notebook["metadata"]["name"]
     url = f"http://{name}.{ns}.svc.cluster.local/notebook/{ns}/{name}/api"
+    # per-endpoint failure handling: a server with terminals disabled
+    # 404s /api/terminals but still reports busy kernels — discarding
+    # the kernel answer would cull an actively-used notebook
     out = {}
     for kind in ("kernels", "terminals"):
         try:
             with urllib.request.urlopen(f"{url}/{kind}", timeout=5) as r:
                 out[kind] = json.load(r)
         except Exception:
-            return None  # unreachable: no activity info this period
-    return out
+            pass
+    return out or None  # both unreachable: no activity info this period
 
 
 class CullingController(Controller):

@@ -98,16 +98,39 @@ class StatefulSetController(Controller):
             if ordinal is None or ordinal >= replicas:
                 api.delete("Pod", pname, ns)
 
+        missing = [i for i in range(replicas)
+                   if f"{req.name}-{i}" not in existing]
+
+        # slice admission is all-or-nothing: pre-check EVERY missing pod
+        # against namespace quota before creating any. Creating ordinals
+        # until one is denied would either leave a rump slice holding
+        # chips while the jax rendezvous waits forever, or (if torn
+        # down) free the quota and retry in an endless create/teardown
+        # loop. Reject whole, once, with an event.
+        requeue = None
+        if missing and not self._missing_pods_fit_quota(api, sts, missing):
+            msg = (f"namespace quota cannot admit all {replicas} hosts "
+                   "of the slice; rejecting whole (slice admission is "
+                   "all-or-nothing)")
+            if not any(e["reason"] == "SliceAdmissionFailed"
+                       and e["message"] == msg
+                       for e in api.events_for(sts)):
+                api.record_event(sts, "Warning", "SliceAdmissionFailed",
+                                 msg)
+            missing = []
+            # nothing watches ResourceQuota: poll so a raised quota
+            # eventually admits the slice (level-triggered retry)
+            requeue = 30.0
+
         # scale up: create missing ordinals (Parallel policy: all at once)
-        for i in range(replicas):
+        for i in missing:
             pname = f"{req.name}-{i}"
-            if pname in existing:
-                continue
             pod = self._render_pod(sts, i)
             set_controller_reference(sts, pod)
             try:
                 api.create(pod)
             except AdmissionDenied as e:
+                # backstop for admission races the pre-check can't see
                 api.record_event(sts, "Warning", "FailedCreate",
                                  f"create Pod {pname} failed: {e}")
                 break  # quota: further ordinals would fail identically
@@ -118,7 +141,47 @@ class StatefulSetController(Controller):
         metrics.TPU_CHIPS_REQUESTED.set(sum(
             _pod_tpu_request(p) for p in api.list("Pod")
             if deep_get(p, "spec", "nodeName")))
-        return None
+        return requeue
+
+    def _missing_pods_fit_quota(self, api: APIServer, sts: dict,
+                                missing: list[int]) -> bool:
+        """Would creating every missing ordinal clear the namespace's
+        ResourceQuotas? Mirrors the apiserver's per-pod enforcement
+        (``apiserver._enforce_quota``) summed over the whole batch."""
+        if not api.quota_enforcement:
+            return True
+        ns = namespace_of(sts)
+        quotas = api.list("ResourceQuota", ns)
+        if not quotas:
+            return True
+        template_pod = self._render_pod(sts, 0)
+        live = [p for p in api.list("Pod", ns)
+                if not p["metadata"].get("deletionTimestamp")]
+        for quota in quotas:
+            hard = deep_get(quota, "spec", "hard", default={}) or {}
+            for resource, limit in hard.items():
+                limit_v = parse_quantity(limit)
+                if resource == "pods":
+                    if len(live) + len(missing) > limit_v:
+                        return False
+                    continue
+                # mirror _enforce_quota exactly: "limits.X" charges
+                # limits only; everything else charges requests
+                # defaulting to limits
+                rname, rkind = resource, "requests"
+                if rname.startswith("requests."):
+                    rname = rname[len("requests."):]
+                elif rname.startswith("limits."):
+                    rname = rname[len("limits."):]
+                    rkind = "limits"
+                per_pod = _pod_resource_request(template_pod, rname, rkind)
+                if not per_pod:
+                    continue
+                used = sum(_pod_resource_request(p, rname, rkind)
+                           for p in live)
+                if used + per_pod * len(missing) > limit_v:
+                    return False
+        return True
 
     # -- pod rendering -------------------------------------------------
     def _render_pod(self, sts: dict, ordinal: int) -> dict:
@@ -161,6 +224,11 @@ class StatefulSetController(Controller):
 
         for pod in sorted(pods, key=name_of):
             if deep_get(pod, "spec", "nodeName"):
+                # pre-pinned (RWO node affinity) or already scheduled:
+                # the kubelet half still owes it a Running status
+                if (self.auto_ready
+                        and deep_get(pod, "status", "phase") != "Running"):
+                    self.mark_running(api, pod)
                 continue
             node = self._pick_node(pod, nodes, used)
             if node is None:
@@ -280,11 +348,22 @@ def _ordinal(pod_name: str, sts_name: str) -> int | None:
 
 
 def _pod_tpu_request(pod: dict) -> float:
+    return _pod_resource_request(pod, GOOGLE_TPU_RESOURCE)
+
+
+def _pod_resource_request(pod: dict, resource: str,
+                          kind: str = "requests") -> float:
+    """kind='requests': requests defaulting to limits (the kube quota
+    convention); kind='limits': limits only — matches
+    ``apiserver._enforce_quota`` so pre-checks and admission agree."""
     total = 0.0
     for c in deep_get(pod, "spec", "containers", default=[]) or []:
-        amount = deep_get(c, "resources", "limits", GOOGLE_TPU_RESOURCE)
-        if amount is None:
-            amount = deep_get(c, "resources", "requests", GOOGLE_TPU_RESOURCE)
+        if kind == "limits":
+            amount = deep_get(c, "resources", "limits", resource)
+        else:
+            amount = deep_get(c, "resources", "requests", resource)
+            if amount is None:
+                amount = deep_get(c, "resources", "limits", resource)
         if amount is not None:
             total += parse_quantity(amount)
     return total

@@ -1,0 +1,8 @@
+"""Web apps (SURVEY.md §2.2–§2.3 layer L5): werkzeug backends over the
+apiserver — jupyter (spawner), volumes, tensorboards, KFAM, dashboard —
+all built on the shared ``core.WebApp`` pipeline (authn/authz/CSRF/
+probes/envelopes), the crud_backend equivalent."""
+
+from kubeflow_rm_tpu.controlplane.webapps.core import WebApp
+
+__all__ = ["WebApp"]

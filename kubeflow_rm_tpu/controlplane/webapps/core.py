@@ -1,0 +1,254 @@
+"""Shared web-app kit — the ``crud_backend`` library of this framework.
+
+The reference factors authn/authz/CSRF/probes/error envelopes into a
+Flask library every web app builds on
+(``crud-web-apps/common/backend/kubeflow/kubeflow/crud_backend/__init__.py:16-35``).
+This is the same factoring on bare werkzeug (no Flask in the TPU
+image), talking to the in-memory apiserver through the identical verb
+surface a kubernetes client would offer:
+
+- **authn** (``authn.py:12-67``): identity arrives as a trusted
+  ``kubeflow-userid`` header stamped by the mesh's auth proxy; the
+  optional prefix (``:``) is stripped. Routes opt out with
+  ``no_auth=True``; ``disable_auth`` handles dev mode.
+- **authz** (``authz.py:101-133``): every mutating/list route declares
+  the k8s verb+resource it performs; the app submits an access review
+  to the apiserver (SubjectAccessReview equivalent) and 403s with the
+  reference's message shape.
+- **CSRF** (``csrf.py``): double-submit cookie — index sets a random
+  ``XSRF-TOKEN`` cookie, unsafe methods must echo it in
+  ``X-XSRF-TOKEN``; GET/HEAD/OPTIONS/TRACE are exempt.
+- **probes** (``probes.py``): ``/healthz`` + ``/readyz``.
+- **envelopes** (``api/utils.py:7-30``): ``{"status", "success",
+  "user", <data_field>}`` on success, ``{"success": False, "log",
+  "status", "user"}`` on failure — the Angular frontends key on these.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import secrets
+import traceback
+from typing import Any, Callable
+
+from werkzeug.exceptions import (
+    BadRequest, Forbidden, HTTPException, Unauthorized,
+)
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from kubeflow_rm_tpu.controlplane.apiserver import (
+    AdmissionDenied, AlreadyExists, APIServer, Invalid, NotFound,
+)
+
+log = logging.getLogger("kubeflow_rm_tpu.webapps")
+
+USER_HEADER = "kubeflow-userid"
+USER_PREFIX = ":"
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "X-XSRF-TOKEN"
+SAFE_METHODS = ("GET", "HEAD", "OPTIONS", "TRACE")
+
+
+class WebApp:
+    """A WSGI app with the crud_backend request pipeline.
+
+    Handlers take ``(req, **url_params)`` and return a dict (JSON
+    envelope added), a Response, or ``(dict, status)``.
+    """
+
+    def __init__(self, name: str, api: APIServer, *, prefix: str = "",
+                 disable_auth: bool = False, secure_cookies: bool = True,
+                 user_header: str = USER_HEADER,
+                 user_prefix: str = USER_PREFIX):
+        self.name = name
+        self.api = api
+        self.prefix = prefix.rstrip("/")
+        self.disable_auth = disable_auth
+        self.secure_cookies = secure_cookies
+        self.user_header = user_header
+        self.user_prefix = user_prefix
+        self._map = Map()
+        self._handlers: dict[str, Callable] = {}
+        self._no_auth: set[str] = set()
+        self._no_csrf: set[str] = set()
+        self.route("/healthz", no_auth=True, no_csrf=True)(_healthz)
+        self.route("/readyz", no_auth=True, no_csrf=True)(_healthz)
+
+    # ---- routing -----------------------------------------------------
+    def route(self, rule: str, methods=("GET",), *, no_auth: bool = False,
+              no_csrf: bool = False):
+        def deco(fn):
+            endpoint = f"{fn.__module__}.{fn.__qualname__}:{rule}"
+            self._map.add(Rule(self.prefix + rule, endpoint=endpoint,
+                               methods=list(methods)))
+            self._handlers[endpoint] = fn
+            if no_auth:
+                self._no_auth.add(endpoint)
+            if no_csrf:
+                self._no_csrf.add(endpoint)
+            return fn
+        return deco
+
+    # ---- identity ----------------------------------------------------
+    def username(self, req: Request) -> str | None:
+        raw = req.headers.get(self.user_header)
+        if raw is None:
+            return None
+        if raw.startswith(self.user_prefix):
+            raw = raw[len(self.user_prefix):]
+        return raw
+
+    def ensure_authorized(self, req: Request, verb: str, resource: str,
+                          namespace: str | None = None) -> None:
+        if self.disable_auth:
+            return
+        user = self.username(req)
+        if user is None:
+            raise Unauthorized("No user credentials were found!")
+        if not self.api.access_review(user, verb, resource, namespace):
+            msg = f"User '{user}' is not authorized to {verb} {resource}"
+            if namespace is not None:
+                msg += f" in namespace '{namespace}'"
+            raise Forbidden(msg)
+
+    # ---- envelopes ---------------------------------------------------
+    def success(self, req: Request, data_field: str | None = None,
+                data: Any = None, status: int = 200) -> Response:
+        body = {"status": status, "success": True,
+                "user": self.username(req)}
+        if data_field is not None:
+            body[data_field] = data
+        return _json_response(body, status)
+
+    def failed(self, req: Request, msg: str, status: int) -> Response:
+        body = {"success": False, "log": msg, "status": status,
+                "user": self.username(req)}
+        return _json_response(body, status)
+
+    # ---- CSRF --------------------------------------------------------
+    def set_csrf_cookie(self, resp: Response) -> None:
+        resp.set_cookie(CSRF_COOKIE, secrets.token_urlsafe(32),
+                        samesite="Strict", httponly=False,
+                        secure=self.secure_cookies,
+                        path=self.prefix or "/")
+        resp.headers["Cache-Control"] = \
+            "no-cache, no-store, must-revalidate, max-age=0"
+
+    def _check_csrf(self, req: Request) -> None:
+        if req.method in SAFE_METHODS:
+            return
+        cookie = req.cookies.get(CSRF_COOKIE)
+        if cookie is None:
+            raise Forbidden(f"Could not find CSRF cookie {CSRF_COOKIE} in "
+                            "the request.")
+        header = req.headers.get(CSRF_HEADER)
+        if header is None:
+            raise Forbidden("Could not detect CSRF protection header "
+                            f"{CSRF_HEADER}.")
+        if header != cookie:
+            raise Forbidden("CSRF check failed. Token in cookie "
+                            f"{CSRF_COOKIE} doesn't match token in header "
+                            f"{CSRF_HEADER}.")
+
+    # ---- WSGI --------------------------------------------------------
+    def __call__(self, environ, start_response):
+        req = Request(environ)
+        try:
+            endpoint, args = self._map.bind_to_environ(environ).match()
+            if not self.disable_auth and endpoint not in self._no_auth:
+                if self.username(req) is None:
+                    raise Unauthorized("No user detected.")
+            if endpoint not in self._no_csrf:
+                self._check_csrf(req)
+            rv = self._handlers[endpoint](req, **args)
+            resp = self._to_response(req, rv)
+        except HTTPException as e:
+            resp = self.failed(req, e.description, e.code)
+        except NotFound as e:
+            resp = self.failed(
+                req, "The requested resource could not be found in the "
+                f"API Server: {e}", 404)
+        except (AlreadyExists,) as e:
+            resp = self.failed(req, str(e), 409)
+        except (Invalid, AdmissionDenied) as e:
+            resp = self.failed(req, str(e), 422)
+        except Exception as e:
+            log.error("unhandled exception on %s: %s\n%s", req.path, e,
+                      traceback.format_exc())
+            resp = self.failed(req, "An error occured in the backend.", 500)
+        return resp(environ, start_response)
+
+    def _to_response(self, req: Request, rv) -> Response:
+        if isinstance(rv, Response):
+            return rv
+        if isinstance(rv, tuple):
+            body, status = rv
+            return _json_response(body, status)
+        if rv is None:
+            return self.success(req)
+        if isinstance(rv, dict):
+            if "success" not in rv:
+                rv = {"status": 200, "success": True,
+                      "user": self.username(req), **rv}
+            return _json_response(rv, rv.get("status", 200))
+        raise TypeError(f"handler returned {type(rv)}")
+
+    # ---- testing -----------------------------------------------------
+    def test_client(self, user: str | None = "user@example.com"):
+        """A werkzeug client with identity + CSRF pre-wired, the way
+        Istio's auth proxy and the SPA would present them."""
+        from werkzeug.test import Client
+        client = Client(self)
+        headers = []
+        if user is not None:
+            headers.append((self.user_header, self.user_prefix + user))
+        token = secrets.token_urlsafe(16)
+        client.set_cookie(CSRF_COOKIE, token, path=self.prefix or "/")
+        headers.append((CSRF_HEADER, token))
+        return _ClientProxy(client, headers)
+
+
+class _ClientProxy:
+    """Adds standing headers to every request of a werkzeug Client."""
+
+    def __init__(self, client, headers):
+        self._client = client
+        self._headers = headers
+
+    def open(self, *args, **kwargs):
+        headers = list(kwargs.pop("headers", []) or [])
+        merged = {k: v for k, v in self._headers}
+        for k, v in headers:
+            merged[k] = v
+        kwargs["headers"] = list(merged.items())
+        return self._client.open(*args, **kwargs)
+
+    def get(self, *a, **kw):
+        return self.open(*a, method="GET", **kw)
+
+    def post(self, *a, **kw):
+        return self.open(*a, method="POST", **kw)
+
+    def patch(self, *a, **kw):
+        return self.open(*a, method="PATCH", **kw)
+
+    def delete(self, *a, **kw):
+        return self.open(*a, method="DELETE", **kw)
+
+
+def _json_response(body: dict, status: int = 200) -> Response:
+    return Response(json.dumps(body), status=status,
+                    mimetype="application/json")
+
+
+def _healthz(req: Request):
+    return {"status": 200, "success": True, "alive": True}
+
+
+def json_body(req: Request) -> dict:
+    try:
+        return json.loads(req.get_data(as_text=True) or "{}")
+    except json.JSONDecodeError as e:
+        raise BadRequest(f"bad JSON body: {e}")

@@ -1,0 +1,208 @@
+"""Central dashboard backend.
+
+Behavioral mirror of the reference centraldashboard's Express server
+(``centraldashboard/app/server.ts:56-91``, ``api.ts:32-99``,
+``api_workgroup.ts``): the navigation shell's API — namespaces,
+per-namespace activity feeds (Events), cluster metrics, dashboard
+links, and the workgroup (profile registration) flow the first-login
+page drives. Identity arrives as the trusted ``kubeflow-userid``
+header exactly as in the reference (``attach_user_middleware.ts``).
+
+TPU differences:
+- ``/api/metrics`` exposes TPU-chip utilization (requested vs
+  allocatable chips per node pool) instead of GPU charts — the
+  numbers come from the same prometheus collectors the controllers
+  maintain (``controlplane/metrics.py``).
+- env-info reports slice inventory so the dashboard can render a
+  fleet view.
+"""
+
+from __future__ import annotations
+
+from werkzeug.exceptions import BadRequest
+
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, parse_quantity
+from kubeflow_rm_tpu.controlplane.api.profile import (
+    KIND as PROFILE_KIND, OWNER_ANNOTATION, make_profile,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.webapps.core import WebApp, json_body
+
+DEFAULT_LINKS = {
+    "menuLinks": [
+        {"link": "/jupyter/", "text": "Notebooks", "icon": "book"},
+        {"link": "/volumes/", "text": "Volumes", "icon": "device:storage"},
+        {"link": "/tensorboards/", "text": "TensorBoards",
+         "icon": "assessment"},
+    ],
+    "externalLinks": [],
+    "quickLinks": [
+        {"desc": "Create a new Notebook server",
+         "link": "/jupyter/new"},
+    ],
+    "documentationItems": [],
+}
+
+
+def create_app(api: APIServer, *, disable_auth: bool = False,
+               prefix: str = "", links: dict | None = None) -> WebApp:
+    app = WebApp("centraldashboard", api, prefix=prefix,
+                 disable_auth=disable_auth)
+    links = links or DEFAULT_LINKS
+
+    # ---- api.ts surface ---------------------------------------------
+    @app.route("/api/namespaces")
+    def get_namespaces(req):
+        return {"namespaces": [n["metadata"]["name"]
+                               for n in api.list("Namespace")]}
+
+    @app.route("/api/activities/<namespace>")
+    def get_activities(req, namespace):
+        evs = sorted(api.list("Event", namespace),
+                     key=lambda e: e.get("lastTimestamp") or "",
+                     reverse=True)
+        return {"events": evs}
+
+    @app.route("/api/dashboard-links")
+    def get_links(req):
+        return dict(links)
+
+    @app.route("/api/metrics")
+    def get_metrics(req):
+        """TPU fleet utilization: the dashboard's resource charts
+        (reference queries Prometheus/Stackdriver —
+        ``prometheus_metrics_service.ts``; the equivalent numbers here
+        come straight from the inventory + scheduled pods)."""
+        per_type: dict[str, dict] = {}
+        used_by_node: dict[str, float] = {}
+        for pod in api.list("Pod"):
+            node = deep_get(pod, "spec", "nodeName")
+            if not node:
+                continue
+            chips = 0.0
+            for c in deep_get(pod, "spec", "containers", default=[]) or []:
+                amt = deep_get(c, "resources", "limits",
+                               tpu_api.GOOGLE_TPU_RESOURCE)
+                if amt is not None:
+                    chips += parse_quantity(amt)
+            if chips:
+                used_by_node[node] = used_by_node.get(node, 0.0) + chips
+        for node in api.list("Node"):
+            labels = node["metadata"].get("labels") or {}
+            accel = labels.get(tpu_api.NODE_LABEL_ACCELERATOR)
+            if not accel:
+                continue
+            alloc = parse_quantity(deep_get(
+                node, "status", "allocatable",
+                tpu_api.GOOGLE_TPU_RESOURCE, default=0))
+            entry = per_type.setdefault(accel, {"allocatable": 0.0,
+                                                "used": 0.0, "nodes": 0})
+            entry["allocatable"] += alloc
+            entry["used"] += used_by_node.get(node["metadata"]["name"], 0.0)
+            entry["nodes"] += 1
+        return {"tpu": per_type}
+
+    # ---- api_workgroup.ts surface -----------------------------------
+    @app.route("/api/workgroup/exists")
+    def workgroup_exists(req):
+        user = app.username(req)
+        owned = [p for p in api.list(PROFILE_KIND)
+                 if deep_get(p, "spec", "owner", "name") == user]
+        member_ns = _member_namespaces(api, user)
+        return {
+            "hasAuth": True,
+            "user": user,
+            "hasWorkgroup": bool(owned) or bool(member_ns),
+            "registrationFlowAllowed": True,
+        }
+
+    @app.route("/api/workgroup/create", methods=("POST",))
+    def workgroup_create(req):
+        user = app.username(req)
+        body = json_body(req)
+        name = body.get("namespace")
+        if not name:
+            raise BadRequest("'namespace' is a required body field")
+        api.create(make_profile(name, user))
+        return {"message": f"Profile {name} created."}
+
+    @app.route("/api/workgroup/env-info")
+    def env_info(req):
+        user = app.username(req)
+        namespaces = _member_namespaces(api, user)
+        slice_types = sorted({
+            t.accelerator_type
+            for node in api.list("Node")
+            for t in [_node_slice_type(node)] if t
+        })
+        return {
+            "user": user,
+            "platform": {"kubeflowVersion": "tpu-native",
+                         "provider": "gke", "providerName": "gke"},
+            "namespaces": [
+                {"namespace": ns, "role": role, "user": user}
+                for ns, role in namespaces
+            ],
+            "isClusterAdmin": api.access_review(user, "*", "*"),
+            "tpuSliceTypes": slice_types,
+        }
+
+    @app.route("/api/workgroup/get-all-namespaces")
+    def get_all_namespaces(req):
+        user = app.username(req)
+        if not api.access_review(user, "*", "*"):
+            from werkzeug.exceptions import Forbidden
+            raise Forbidden("cluster admin required")
+        out = []
+        for ns in api.list("Namespace"):
+            owner = (ns["metadata"].get("annotations") or {}).get(
+                OWNER_ANNOTATION)
+            out.append({"namespace": ns["metadata"]["name"],
+                        "owner": owner})
+        return {"namespaces": out}
+
+    @app.route("/api/workgroup/get-contributors/<namespace>")
+    def get_contributors(req, namespace):
+        from kubeflow_rm_tpu.controlplane.webapps.kfam import (
+            ROLE_ANNOTATION, USER_ANNOTATION,
+        )
+        out = []
+        for rb in api.list("RoleBinding", namespace):
+            ann = rb["metadata"].get("annotations") or {}
+            if USER_ANNOTATION in ann:
+                out.append({"user": ann[USER_ANNOTATION],
+                            "role": ann.get(ROLE_ANNOTATION)})
+        return {"contributors": out}
+
+    return app
+
+
+def _member_namespaces(api: APIServer, user: str | None):
+    """(namespace, role) pairs where the user holds a binding — the
+    dashboard's namespace selector contents."""
+    out = []
+    for ns in api.list("Namespace"):
+        ns_name = ns["metadata"]["name"]
+        owner = (ns["metadata"].get("annotations") or {}).get(
+            OWNER_ANNOTATION)
+        if owner == user:
+            out.append((ns_name, "owner"))
+            continue
+        for rb in api.list("RoleBinding", ns_name):
+            if any(s.get("name") == user
+                   for s in rb.get("subjects") or []):
+                role = deep_get(rb, "roleRef", "name", default="")
+                out.append((ns_name, "contributor"
+                            if "admin" not in role else "owner"))
+                break
+    return out
+
+
+def _node_slice_type(node: dict):
+    labels = node["metadata"].get("labels") or {}
+    accel = labels.get(tpu_api.NODE_LABEL_ACCELERATOR)
+    topo = labels.get(tpu_api.NODE_LABEL_TOPOLOGY)
+    if accel and topo:
+        return tpu_api.by_node_labels(accel, topo)
+    return None

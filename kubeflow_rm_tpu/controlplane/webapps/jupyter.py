@@ -1,0 +1,390 @@
+"""Jupyter web app backend — the TPU-slice spawner.
+
+Behavioral mirror of the reference JWA backend
+(``crud-web-apps/jupyter/backend``): the spawner-config contract with
+``{value, readOnly, options}`` enforced server-side (``form.py:15-59``),
+form→Notebook-CR assembly (``form.py:74-299``,
+``routes/post.py:12-75``), start/stop via the stop annotation, the
+status ladder, and accelerator discovery — where the reference
+intersects node capacity keys with configured GPU vendor limitsKeys
+(``routes/get.py:101-126``), ``/api/tpus`` intersects the config's
+slice presets with the cluster's live TPU node inventory, so the
+picker only offers obtainable slices.
+
+TPU differences by design:
+- one ``tpu.acceleratorType`` field replaces {vendor, num}: chips,
+  hosts, nodeSelectors, and rendezvous env are derived downstream
+  (controller + webhook), never chosen by the user;
+- ``/dev/shm`` stays (reference ``form.py:264-276``) for host-local
+  torch dataloaders, but TPU collectives ride ICI — no NCCL.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import yaml
+from werkzeug.exceptions import BadRequest
+
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    annotations_of, deep_get, set_annotation,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.webapps import status as status_mod
+from kubeflow_rm_tpu.controlplane.webapps.core import WebApp, json_body
+
+DEFAULT_CONFIG = __file__.rsplit("/", 1)[0] + "/spawner_ui_config.yaml"
+
+
+def load_spawner_config(path: str | None = None) -> dict:
+    with open(path or DEFAULT_CONFIG) as f:
+        return yaml.safe_load(f)["spawnerFormDefaults"]
+
+
+def get_form_value(body: dict, defaults: dict, body_field: str,
+                   defaults_field: str | None = None, optional: bool = False):
+    """readOnly-aware form value resolution (reference form.py:15-59)."""
+    defaults_field = defaults_field or body_field
+    user_value = body.get(body_field)
+    if defaults_field not in defaults:
+        return user_value
+    entry = defaults[defaults_field]
+    if entry.get("readOnly", False):
+        if body_field in body:
+            raise BadRequest(
+                f"'{body_field}' is readonly but a value was provided: "
+                f"{user_value}")
+        return entry["value"]
+    if user_value is None:
+        if not optional:
+            raise BadRequest(f"No value provided for: {body_field}")
+        return None
+    return user_value
+
+
+# --- form setters (reference form.py:74-299, TPU-adapted) -------------
+
+def _container(nb: dict) -> dict:
+    return nb["spec"]["template"]["spec"]["containers"][0]
+
+
+def set_image(nb: dict, body: dict, defaults: dict) -> None:
+    field = "customImage" if body.get("customImage") else "image"
+    image = get_form_value(body, defaults, field, "image")
+    _container(nb)["image"] = image.strip()
+    policy = get_form_value(body, defaults, "imagePullPolicy")
+    _container(nb)["imagePullPolicy"] = policy
+
+
+def set_server_type(nb: dict, body: dict, defaults: dict) -> None:
+    valid = ("jupyter", "group-one", "group-two")
+    server_type = get_form_value(body, defaults, "serverType") or "jupyter"
+    if server_type not in valid:
+        raise BadRequest(f"'{server_type}' is not a valid server type")
+    set_annotation(nb, nb_api.SERVER_TYPE_ANNOTATION, server_type)
+    if server_type in ("group-one", "group-two"):
+        set_annotation(nb, nb_api.REWRITE_URI_ANNOTATION, "/")
+
+
+def _reject_nan(value: str, what: str) -> None:
+    if value and "nan" in value.lower():
+        raise BadRequest(f"Invalid value for {what}: {value}")
+
+
+def set_cpu(nb: dict, body: dict, defaults: dict) -> None:
+    cpu = get_form_value(body, defaults, "cpu")
+    _reject_nan(cpu, "cpu")
+    limit = get_form_value(body, defaults, "cpuLimit", optional=True)
+    _reject_nan(limit or "", "cpu limit")
+    factor = defaults.get("cpu", {}).get("limitFactor", "none")
+    if not limit and factor != "none":
+        limit = str(round(float(cpu) * float(factor), 1))
+    res = _container(nb).setdefault("resources", {})
+    res.setdefault("requests", {})["cpu"] = cpu
+    if limit:
+        if float(limit) < float(cpu):
+            raise BadRequest("CPU limit must be greater than the request")
+        res.setdefault("limits", {})["cpu"] = limit
+
+
+def set_memory(nb: dict, body: dict, defaults: dict) -> None:
+    memory = get_form_value(body, defaults, "memory")
+    _reject_nan(memory, "memory")
+    limit = get_form_value(body, defaults, "memoryLimit", optional=True)
+    _reject_nan(limit or "", "memory limit")
+    factor = defaults.get("memory", {}).get("limitFactor", "none")
+    if not limit and factor != "none":
+        limit = str(round(float(memory.replace("Gi", "")) * float(factor),
+                          1)) + "Gi"
+    res = _container(nb).setdefault("resources", {})
+    res.setdefault("requests", {})["memory"] = memory
+    if limit:
+        if float(limit.replace("Gi", "")) < float(memory.replace("Gi", "")):
+            raise BadRequest("Memory limit must be greater than the request")
+        res.setdefault("limits", {})["memory"] = limit
+
+
+def set_tpu(nb: dict, body: dict, defaults: dict) -> None:
+    """The reference's set_notebook_gpus seam (form.py:226-250), TPU
+    shape: a single acceleratorType names the whole slice."""
+    tpu = get_form_value(body, defaults, "tpu")
+    if not tpu:
+        return
+    accel = tpu.get("acceleratorType", "none")
+    if accel == "none":
+        return
+    try:
+        topo = tpu_api.lookup(accel)
+    except tpu_api.UnknownAcceleratorType as e:
+        raise BadRequest(str(e))
+    allowed = defaults.get("tpu", {}).get("options")
+    if allowed and accel not in allowed:
+        raise BadRequest(
+            f"acceleratorType {accel!r} is not offered by this "
+            f"deployment's spawner config")
+    nb["spec"]["tpu"] = {"acceleratorType": topo.accelerator_type}
+
+
+def set_tolerations(nb: dict, body: dict, defaults: dict) -> None:
+    key = get_form_value(body, defaults, "tolerationGroup")
+    if key == "none":
+        return
+    for group in defaults.get("tolerationGroup", {}).get("options", []):
+        if group.get("groupKey") == key:
+            spec = nb["spec"]["template"]["spec"]
+            spec.setdefault("tolerations", []).extend(group["tolerations"])
+            return
+    raise BadRequest(f"No Toleration Group with key {key!r} in the config")
+
+
+def set_affinity(nb: dict, body: dict, defaults: dict) -> None:
+    key = get_form_value(body, defaults, "affinityConfig")
+    if key == "none":
+        return
+    for cfg in defaults.get("affinityConfig", {}).get("options", []):
+        if cfg.get("configKey") == key:
+            nb["spec"]["template"]["spec"]["affinity"] = cfg["affinity"]
+            return
+    raise BadRequest(f"No Affinity Config with key {key!r} in the config")
+
+
+def set_configurations(nb: dict, body: dict, defaults: dict) -> None:
+    labels = get_form_value(body, defaults, "configurations")
+    if not isinstance(labels, list):
+        raise BadRequest(f"Labels for PodDefaults are not list: {labels}")
+    for label in labels:
+        nb["metadata"].setdefault("labels", {})[label] = "true"
+
+
+def set_shm(nb: dict, body: dict, defaults: dict) -> None:
+    if not get_form_value(body, defaults, "shm"):
+        return
+    spec = nb["spec"]["template"]["spec"]
+    spec.setdefault("volumes", []).append(
+        {"name": "dshm", "emptyDir": {"medium": "Memory"}})
+    _container(nb).setdefault("volumeMounts", []).append(
+        {"mountPath": "/dev/shm", "name": "dshm"})
+
+
+def set_environment(nb: dict, body: dict, defaults: dict) -> None:
+    env = get_form_value(body, defaults, "environment") or {}
+    if isinstance(env, str):
+        import json
+        env = json.loads(env) if env else {}
+    _container(nb).setdefault("env", []).extend(
+        {"name": k, "value": str(v)} for k, v in env.items())
+
+
+def _materialize_volume(api: APIServer, ns: str, nb: dict,
+                        vol: dict) -> None:
+    """One workspace/data volume: create its PVC if newPvc, then mount."""
+    mount = vol.get("mount")
+    if not mount:
+        raise BadRequest("volume requires a 'mount' path")
+    if "newPvc" in vol:
+        pvc = copy.deepcopy(vol["newPvc"])
+        name = deep_get(pvc, "metadata", "name", default="") or ""
+        name = name.replace("{notebook-name}", nb["metadata"]["name"])
+        pvc.setdefault("metadata", {})["name"] = name
+        pvc["metadata"]["namespace"] = ns
+        pvc.setdefault("apiVersion", "v1")
+        pvc.setdefault("kind", "PersistentVolumeClaim")
+        api.create(pvc)
+        claim = name
+    elif "existingSource" in vol:
+        claim = deep_get(vol, "existingSource", "persistentVolumeClaim",
+                         "claimName")
+        if not claim:
+            raise BadRequest("existingSource requires a PVC claimName")
+    else:
+        raise BadRequest("volume must specify newPvc or existingSource")
+    vol_name = claim
+    spec = nb["spec"]["template"]["spec"]
+    spec.setdefault("volumes", []).append(
+        {"name": vol_name, "persistentVolumeClaim": {"claimName": claim}})
+    _container(nb).setdefault("volumeMounts", []).append(
+        {"mountPath": mount, "name": vol_name})
+
+
+# --- the app ----------------------------------------------------------
+
+def create_app(api: APIServer, *, config_path: str | None = None,
+               disable_auth: bool = False, prefix: str = "") -> WebApp:
+    app = WebApp("jupyter", api, prefix=prefix, disable_auth=disable_auth)
+    defaults = load_spawner_config(config_path)
+
+    @app.route("/api/config")
+    def get_config(req):
+        return {"config": defaults}
+
+    @app.route("/api/namespaces")
+    def get_namespaces(req):
+        app.ensure_authorized(req, "list", "namespaces")
+        return {"namespaces": [n["metadata"]["name"]
+                               for n in api.list("Namespace")]}
+
+    @app.route("/api/tpus")
+    def get_tpus(req):
+        """Slice types that are both configured and present in the
+        node inventory (generalizes /api/gpus, routes/get.py:101-126)."""
+        offered = [o for o in defaults.get("tpu", {}).get("options", [])
+                   if o != "none"]
+        live = set()
+        for node in api.list("Node"):
+            labels = node["metadata"].get("labels") or {}
+            accel = labels.get(tpu_api.NODE_LABEL_ACCELERATOR)
+            topo = labels.get(tpu_api.NODE_LABEL_TOPOLOGY)
+            if accel and topo:
+                t = tpu_api.by_node_labels(accel, topo)
+                if t:
+                    live.add(t.accelerator_type)
+        available = [o for o in offered if o in live]
+        return {"tpus": [
+            {"acceleratorType": a,
+             "chips": tpu_api.lookup(a).chips,
+             "hosts": tpu_api.lookup(a).hosts,
+             "topology": tpu_api.lookup(a).topology}
+            for a in available]}
+
+    @app.route("/api/namespaces/<namespace>/notebooks")
+    def list_notebooks(req, namespace):
+        app.ensure_authorized(req, "list", "notebooks", namespace)
+        out = []
+        for nb in api.list(nb_api.KIND, namespace):
+            st = status_mod.process_status(nb, api.events_for(nb))
+            out.append(_summarize(nb, st))
+        return {"notebooks": out}
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>")
+    def get_notebook(req, namespace, name):
+        app.ensure_authorized(req, "get", "notebooks", namespace)
+        nb = api.get(nb_api.KIND, name, namespace)
+        nb["processed_status"] = status_mod.process_status(
+            nb, api.events_for(nb)).to_dict()
+        return {"notebook": nb}
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>/events")
+    def get_notebook_events(req, namespace, name):
+        app.ensure_authorized(req, "get", "notebooks", namespace)
+        nb = api.get(nb_api.KIND, name, namespace)
+        return {"events": api.events_for(nb)}
+
+    @app.route("/api/namespaces/<namespace>/notebooks", methods=("POST",))
+    def post_notebook(req, namespace):
+        app.ensure_authorized(req, "create", "notebooks", namespace)
+        body = json_body(req)
+        if "name" not in body:
+            raise BadRequest("'name' is a required body field")
+        user = app.username(req) or "anonymous@kubeflow.org"
+
+        nb = nb_api.make_notebook(body["name"], namespace)
+        nb["metadata"].setdefault("labels", {})
+        nb["metadata"].setdefault("annotations", {})
+        nb["spec"]["template"]["spec"]["serviceAccountName"] = \
+            "default-editor"
+        set_annotation(nb, "notebooks.kubeflow.org/creator", user)
+
+        set_image(nb, body, defaults)
+        set_server_type(nb, body, defaults)
+        set_cpu(nb, body, defaults)
+        set_memory(nb, body, defaults)
+        set_tpu(nb, body, defaults)
+        set_tolerations(nb, body, defaults)
+        set_affinity(nb, body, defaults)
+        set_configurations(nb, body, defaults)
+        set_shm(nb, body, defaults)
+        set_environment(nb, body, defaults)
+
+        vols = list(get_form_value(body, defaults, "datavols", "dataVolumes")
+                    or [])
+        workspace = get_form_value(body, defaults, "workspace",
+                                   "workspaceVolume", optional=True)
+        if workspace:
+            vols.insert(0, workspace)
+        for vol in vols:
+            _materialize_volume(api, namespace, nb, vol)
+
+        api.create(nb)
+        return {"message": "Notebook created successfully."}
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>",
+               methods=("PATCH",))
+    def patch_notebook(req, namespace, name):
+        app.ensure_authorized(req, "update", "notebooks", namespace)
+        body = json_body(req)
+        nb = api.get(nb_api.KIND, name, namespace)
+        if "stopped" in body:
+            ann = annotations_of(nb)
+            if body["stopped"]:
+                set_annotation(nb, nb_api.STOP_ANNOTATION,
+                               api.clock().isoformat())
+            else:
+                ann.pop(nb_api.STOP_ANNOTATION, None)
+            api.update(nb)
+        return {"message": "Notebook updated successfully."}
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>",
+               methods=("DELETE",))
+    def delete_notebook(req, namespace, name):
+        app.ensure_authorized(req, "delete", "notebooks", namespace)
+        api.delete(nb_api.KIND, name, namespace)
+        return {"message": "Notebook deleted successfully."}
+
+    @app.route("/api/namespaces/<namespace>/pvcs")
+    def list_pvcs(req, namespace):
+        app.ensure_authorized(req, "list", "persistentvolumeclaims",
+                              namespace)
+        return {"pvcs": api.list("PersistentVolumeClaim", namespace)}
+
+    @app.route("/api/namespaces/<namespace>/poddefaults")
+    def list_poddefaults(req, namespace):
+        app.ensure_authorized(req, "list", "poddefaults", namespace)
+        pds = api.list("PodDefault", namespace)
+        return {"poddefaults": [
+            {"label": deep_get(p, "spec", "selector", "matchLabels",
+                               default={}),
+             "desc": deep_get(p, "spec", "desc",
+                              default=p["metadata"]["name"]),
+             "name": p["metadata"]["name"]}
+            for p in pds]}
+
+    return app
+
+
+def _summarize(nb: dict, st) -> dict:
+    topo = nb_api.tpu_spec(nb)
+    container = deep_get(nb, "spec", "template", "spec", "containers", 0,
+                         default={})
+    return {
+        "name": nb["metadata"]["name"],
+        "namespace": nb["metadata"]["namespace"],
+        "image": container.get("image"),
+        "serverType": annotations_of(nb).get(nb_api.SERVER_TYPE_ANNOTATION),
+        "tpu": ({"acceleratorType": topo.accelerator_type,
+                 "chips": topo.chips, "hosts": topo.hosts}
+                if topo else None),
+        "status": st.to_dict(),
+        "age": nb["metadata"].get("creationTimestamp"),
+    }

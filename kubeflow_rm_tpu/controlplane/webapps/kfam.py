@@ -1,0 +1,162 @@
+"""Access management (KFAM) REST service.
+
+Behavioral mirror of the reference's Go KFAM
+(``access-management/kfam/routers.go:32-90``): the dashboard's
+profile/contributor management API. Endpoints:
+
+- ``/kfam/v1/bindings`` GET/POST/DELETE — contributor management: a
+  binding ``{user, referredNamespace, roleRef}`` becomes a RoleBinding
+  (role mapped through admin/edit/view → kubeflow-* —
+  ``bindings.go:33-40``) plus an Istio AuthorizationPolicy admitting
+  that user's identity header through the gateway
+  (``bindings.go:79-157``).
+- ``/kfam/v1/profiles`` POST / ``/kfam/v1/profiles/<name>`` DELETE —
+  registration flow (``api_default.go:134-156``).
+- ``/kfam/v1/role/clusteradmin`` GET — admin check backed by the
+  apiserver's access review (the reference submits a
+  SubjectAccessReview — ``api_default.go:104-132``).
+"""
+
+from __future__ import annotations
+
+import re
+
+from werkzeug.exceptions import BadRequest
+
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+from kubeflow_rm_tpu.controlplane.api.profile import make_profile
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.webapps.core import (
+    USER_HEADER, USER_PREFIX, WebApp, json_body,
+)
+
+USER_ANNOTATION = "user"
+ROLE_ANNOTATION = "role"
+
+ROLE_MAP = {"admin": "kubeflow-admin", "edit": "kubeflow-edit",
+            "view": "kubeflow-view"}
+
+
+def binding_name(user: str, role: str) -> str:
+    safe = re.sub(r"[^a-z0-9]", "-", user.lower())
+    return f"user-{safe}-clusterrole-{ROLE_MAP[role]}"
+
+
+def create_app(api: APIServer, *, disable_auth: bool = False,
+               prefix: str = "") -> WebApp:
+    app = WebApp("kfam", api, prefix=prefix, disable_auth=disable_auth)
+
+    @app.route("/kfam/v1/bindings")
+    def get_bindings(req):
+        ns_filter = req.args.get("namespace")
+        user_filter = req.args.get("user")
+        role_filter = req.args.get("role")
+        out = []
+        namespaces = ([ns_filter] if ns_filter else
+                      [n["metadata"]["name"]
+                       for n in api.list("Namespace")])
+        for ns in namespaces:
+            for rb in api.list("RoleBinding", ns):
+                ann = rb["metadata"].get("annotations") or {}
+                if USER_ANNOTATION not in ann:
+                    continue  # not a KFAM-managed binding
+                role = ann.get(ROLE_ANNOTATION)
+                if user_filter and ann[USER_ANNOTATION] != user_filter:
+                    continue
+                if role_filter and role != role_filter:
+                    continue
+                out.append({
+                    "user": {"kind": "User",
+                             "name": ann[USER_ANNOTATION]},
+                    "referredNamespace": ns,
+                    "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                                "kind": "ClusterRole", "name": role},
+                })
+        return {"bindings": out}
+
+    @app.route("/kfam/v1/bindings", methods=("POST",))
+    def post_binding(req):
+        b = _parse_binding(json_body(req))
+        ns, user, role = b
+        app.ensure_authorized(req, "create", "rolebindings", ns)
+        name = binding_name(user, role)
+        rb = make_object("rbac.authorization.k8s.io/v1", "RoleBinding",
+                         name, ns,
+                         annotations={USER_ANNOTATION: user,
+                                      ROLE_ANNOTATION: role})
+        rb["roleRef"] = {"apiGroup": "rbac.authorization.k8s.io",
+                         "kind": "ClusterRole", "name": ROLE_MAP[role]}
+        rb["subjects"] = [{"kind": "User", "name": user,
+                           "apiGroup": "rbac.authorization.k8s.io"}]
+        api.create(rb)
+
+        authz = make_object("security.istio.io/v1beta1",
+                            "AuthorizationPolicy", name, ns,
+                            annotations={USER_ANNOTATION: user,
+                                         ROLE_ANNOTATION: role})
+        authz["spec"] = {"rules": [{
+            "when": [{
+                "key": f"request.headers[{USER_HEADER}]",
+                "values": [USER_PREFIX + user],
+            }],
+        }]}
+        api.create(authz)
+        return {"message": "Binding created successfully."}
+
+    @app.route("/kfam/v1/bindings", methods=("DELETE",))
+    def delete_binding(req):
+        ns, user, role = _parse_binding(json_body(req))
+        app.ensure_authorized(req, "delete", "rolebindings", ns)
+        name = binding_name(user, role)
+        api.delete("RoleBinding", name, ns)
+        if api.try_get("AuthorizationPolicy", name, ns):
+            api.delete("AuthorizationPolicy", name, ns)
+        return {"message": "Binding deleted successfully."}
+
+    @app.route("/kfam/v1/profiles")
+    def get_profiles(req):
+        return {"profiles": api.list("Profile")}
+
+    @app.route("/kfam/v1/profiles", methods=("POST",))
+    def post_profile(req):
+        body = json_body(req)
+        name = deep_get(body, "metadata", "name")
+        owner = deep_get(body, "spec", "owner", "name")
+        if not name or not owner:
+            raise BadRequest("profile requires metadata.name and "
+                             "spec.owner.name")
+        api.create(make_profile(name, owner))
+        return {"message": "Profile created successfully."}
+
+    @app.route("/kfam/v1/profiles/<name>", methods=("DELETE",))
+    def delete_profile(req, name):
+        profile = api.get("Profile", name)
+        user = app.username(req)
+        owner = deep_get(profile, "spec", "owner", "name")
+        if not app.disable_auth and user not in (owner,) and \
+                not api.access_review(user, "delete", "profiles"):
+            from werkzeug.exceptions import Forbidden
+            raise Forbidden(f"User '{user}' may not delete profile "
+                            f"'{name}' owned by '{owner}'")
+        api.delete("Profile", name)
+        return {"message": "Profile deleted successfully."}
+
+    @app.route("/kfam/v1/role/clusteradmin")
+    def get_clusteradmin(req):
+        user = req.args.get("user") or app.username(req)
+        is_admin = api.access_review(user, "*", "*")
+        return {"clusteradmin": bool(is_admin)}
+
+    return app
+
+
+def _parse_binding(body: dict) -> tuple[str, str, str]:
+    user = deep_get(body, "user", "name")
+    ns = body.get("referredNamespace")
+    role = deep_get(body, "roleRef", "name")
+    if not (user and ns and role):
+        raise BadRequest("binding requires user.name, referredNamespace "
+                         "and roleRef.name")
+    if role not in ROLE_MAP:
+        raise BadRequest(f"roleRef.name must be one of {sorted(ROLE_MAP)}")
+    return ns, user, role

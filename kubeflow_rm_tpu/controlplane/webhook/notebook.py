@@ -54,6 +54,8 @@ class NotebookWebhook:
             notebook = copy.deepcopy(notebook)
             self._inject_lock(notebook)
             self._resolve_image(notebook)
+            self._mount_ca_bundle(notebook)
+            self._inject_oauth_proxy(notebook)
             return notebook
         if op == "UPDATE" and old is not None:
             self._guard_restart(notebook, old)
@@ -76,6 +78,84 @@ class NotebookWebhook:
             img = c.get("image", "")
             if img in images:
                 c["image"] = images[img]
+
+    def _mount_ca_bundle(self, notebook: dict) -> None:
+        """CheckAndMountCACertBundle (``notebook_webhook.go:373-420``):
+        if the namespace carries the assembled trusted-CA ConfigMap
+        (written by the AuthCompanionController), mount it where tls
+        libraries look."""
+        from kubeflow_rm_tpu.controlplane.controllers.authcompanion import (
+            SOURCE_CA_BUNDLE, SOURCE_CA_NAMESPACE, TRUSTED_CA_BUNDLE,
+        )
+        # key on the CLUSTER source bundle, not the namespace copy: for
+        # the first notebook in a namespace the AuthCompanionController
+        # hasn't assembled the copy yet (it triggers off this very
+        # Notebook). The volume is optional, so the kubelet back-fills
+        # once the controller writes the ConfigMap.
+        if self.api.try_get("ConfigMap", SOURCE_CA_BUNDLE,
+                            SOURCE_CA_NAMESPACE) is None:
+            return
+        spec = deep_get(notebook, "spec", "template", "spec", default={})
+        vols = spec.setdefault("volumes", [])
+        if any(v.get("name") == "trusted-ca" for v in vols):
+            return
+        vols.append({
+            "name": "trusted-ca",
+            "configMap": {"name": TRUSTED_CA_BUNDLE, "optional": True,
+                          "items": [{"key": "ca-bundle.crt",
+                                     "path": "tls-ca-bundle.pem"}]},
+        })
+        for c in spec.get("containers", []):
+            c.setdefault("volumeMounts", []).append({
+                "name": "trusted-ca",
+                "mountPath": "/etc/pki/tls/certs",
+                "readOnly": True,
+            })
+
+    def _inject_oauth_proxy(self, notebook: dict) -> None:
+        """InjectOAuthProxy (``notebook_webhook.go:76-233``): opt-in
+        sidecar that authenticates every request before it reaches
+        JupyterLab on worker 0."""
+        from kubeflow_rm_tpu.controlplane.controllers.authcompanion import (
+            OAUTH_PORT, OAUTH_PORT_NAME, oauth_enabled,
+        )
+        if not oauth_enabled(notebook):
+            return
+        name, ns = name_of(notebook), namespace_of(notebook)
+        spec = deep_get(notebook, "spec", "template", "spec", default={})
+        containers = spec.setdefault("containers", [])
+        if any(c.get("name") == "oauth-proxy" for c in containers):
+            return
+        containers.append({
+            "name": "oauth-proxy",
+            "image": "oauth-proxy:latest",
+            "args": [
+                f"--provider=openshift",
+                f"--upstream=http://localhost:8888",
+                f"--https-address=:{OAUTH_PORT}",
+                f"--openshift-service-account={name}",
+                "--cookie-secret-file=/etc/oauth/config/cookie_secret",
+                "--tls-cert=/etc/tls/private/tls.crt",
+                "--tls-key=/etc/tls/private/tls.key",
+                f"--openshift-sar={{\"verb\":\"get\",\"resource\":"
+                f"\"notebooks\",\"namespace\":\"{ns}\"}}",
+            ],
+            "ports": [{"containerPort": OAUTH_PORT,
+                       "name": OAUTH_PORT_NAME, "protocol": "TCP"}],
+            "volumeMounts": [
+                {"name": "oauth-config",
+                 "mountPath": "/etc/oauth/config"},
+                {"name": "tls-certificates",
+                 "mountPath": "/etc/tls/private"},
+            ],
+        })
+        spec.setdefault("volumes", []).extend([
+            {"name": "oauth-config",
+             "secret": {"secretName": f"{name}-oauth-config"}},
+            {"name": "tls-certificates",
+             "secret": {"secretName": f"{name}-tls", "optional": True}},
+        ])
+        spec["serviceAccountName"] = name
 
     def _guard_restart(self, new: dict, old: dict) -> None:
         old_ann = annotations_of(old)

@@ -1,0 +1,108 @@
+"""Auth companion controller + webhook OAuth/CA behaviors
+(odh-notebook-controller: notebook_oauth.go:49-266,
+notebook_network.go:131-174, notebook_rbac.go:36-154,
+notebook_controller.go:254-357, notebook_webhook.go:76-233,373-420)."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.controllers.authcompanion import (
+    OAUTH_INJECT_ANNOTATION, SOURCE_CA_BUNDLE, SOURCE_CA_NAMESPACE,
+    TRUSTED_CA_BUNDLE,
+)
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import make_tpu_node
+
+
+@pytest.fixture
+def stack():
+    api, mgr = make_control_plane()
+    api.ensure_namespace("ns")
+    return api, mgr
+
+
+def test_plain_route_and_network_policy(stack):
+    api, mgr = stack
+    api.create(make_notebook("plain", "ns"))
+    mgr.run_until_idle()
+
+    route = api.get("Route", "plain", "ns")
+    assert deep_get(route, "spec", "to", "name") == "plain"
+    assert "tls" not in route["spec"]
+
+    np = api.get("NetworkPolicy", "plain-ctrl-np", "ns")
+    ingress = deep_get(np, "spec", "ingress", 0)
+    assert ingress["ports"][0]["port"] == 8888
+    assert deep_get(ingress, "from", 0, "namespaceSelector",
+                    "matchLabels")["kubernetes.io/metadata.name"] == "ns"
+
+    rb = api.get("RoleBinding", "elyra-pipelines-plain", "ns")
+    assert rb["subjects"][0] == {"kind": "ServiceAccount", "name": "plain",
+                                 "namespace": "ns"}
+
+
+def test_oauth_machinery_and_sidecar(stack):
+    api, mgr = stack
+    nb = make_notebook("secure", "ns",
+                       annotations={OAUTH_INJECT_ANNOTATION: "true"})
+    api.create(nb)
+    mgr.run_until_idle()
+
+    # controller half: SA, tls Service, oauth Secret, reencrypt Route
+    sa = api.get("ServiceAccount", "secure", "ns")
+    assert "oauth-redirectreference" in str(sa["metadata"]["annotations"])
+    svc = api.get("Service", "secure-tls", "ns")
+    assert svc["spec"]["ports"][0]["port"] == 443
+    secret = api.get("Secret", "secure-oauth-config", "ns")
+    assert secret["stringData"]["cookie_secret"]
+    route = api.get("Route", "secure", "ns")
+    assert deep_get(route, "spec", "tls", "termination") == "reencrypt"
+    assert deep_get(route, "spec", "to", "name") == "secure-tls"
+    api.get("NetworkPolicy", "secure-oauth-np", "ns")
+
+    # webhook half: the sidecar is in the stored CR's pod template
+    stored = api.get("Notebook", "secure", "ns")
+    containers = deep_get(stored, "spec", "template", "spec", "containers")
+    proxy = next(c for c in containers if c["name"] == "oauth-proxy")
+    assert any("--upstream=http://localhost:8888" in a
+               for a in proxy["args"])
+    assert deep_get(stored, "spec", "template", "spec",
+                    "serviceAccountName") == "secure"
+
+
+def test_multihost_slice_gets_peer_network_policy(stack):
+    api, mgr = stack
+    for i in range(2):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    api.create(make_notebook("slice", "ns", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    np = api.get("NetworkPolicy", "slice-slice-np", "ns")
+    ingress = deep_get(np, "spec", "ingress", 0)
+    # rendezvous ports only reachable from the slice's own pods
+    assert deep_get(ingress, "from", 0, "podSelector", "matchLabels") == \
+        {"notebook-name": "slice"}
+
+
+def test_ca_bundle_assembled_and_mounted(stack):
+    api, mgr = stack
+    api.ensure_namespace(SOURCE_CA_NAMESPACE)
+    src = make_object("v1", "ConfigMap", SOURCE_CA_BUNDLE,
+                      SOURCE_CA_NAMESPACE)
+    src["data"] = {"root.crt": "AAA\n", "intermediate.crt": "BBB\n",
+                   "readme.txt": "ignored"}
+    api.create(src)
+
+    # companion assembles the namespace bundle on first reconcile of
+    # any notebook; webhook mounts it into notebooks created after
+    api.create(make_notebook("first", "ns"))
+    mgr.run_until_idle()
+    cm = api.get("ConfigMap", TRUSTED_CA_BUNDLE, "ns")
+    assert cm["data"]["ca-bundle.crt"] == "BBB\nAAA\n"  # sorted keys
+
+    api.create(make_notebook("second", "ns"))
+    stored = api.get("Notebook", "second", "ns")
+    spec = deep_get(stored, "spec", "template", "spec")
+    assert any(v.get("name") == "trusted-ca" for v in spec["volumes"])
+    assert any(m["mountPath"] == "/etc/pki/tls/certs"
+               for m in spec["containers"][0]["volumeMounts"])

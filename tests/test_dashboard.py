@@ -1,0 +1,95 @@
+"""Central dashboard backend (centraldashboard/app/api.ts:32-99,
+api_workgroup.ts registration flow)."""
+
+import json
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api.meta import make_object
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import make_tpu_node
+from kubeflow_rm_tpu.controlplane.webapps import dashboard
+
+USER = "alice@corp.com"
+
+
+@pytest.fixture
+def stack():
+    return make_control_plane()
+
+
+def get_json(client, url):
+    resp = client.get(url)
+    assert resp.status_code == 200, resp.get_data()
+    return json.loads(resp.get_data())
+
+
+def test_workgroup_registration_flow(stack):
+    api, mgr = stack
+    app = dashboard.create_app(api)
+    client = app.test_client(user=USER)
+
+    # first login: no workgroup yet
+    assert get_json(client, "/api/workgroup/exists")["hasWorkgroup"] is False
+
+    resp = client.post("/api/workgroup/create",
+                       data=json.dumps({"namespace": "alice"}),
+                       headers=[("Content-Type", "application/json")])
+    assert resp.status_code == 200, resp.get_data()
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+
+    assert get_json(client, "/api/workgroup/exists")["hasWorkgroup"] is True
+    info = get_json(client, "/api/workgroup/env-info")
+    assert {"namespace": "alice", "role": "owner", "user": USER} in \
+        info["namespaces"]
+    assert info["isClusterAdmin"] is False
+
+    # namespaces endpoint sees the provisioned namespace
+    assert "alice" in get_json(client, "/api/namespaces")["namespaces"]
+
+
+def test_activities_surface_namespace_events(stack):
+    api, mgr = stack
+    app = dashboard.create_app(api)
+    client = app.test_client(user=USER)
+    api.ensure_namespace("team")
+    nb = make_notebook("nb", "team", accelerator_type="v5litepod-16")
+    api.create(nb)
+    mgr.run_until_idle()  # no nodes -> FailedScheduling events
+    evs = get_json(client, "/api/activities/team")["events"]
+    assert any(e["reason"] == "FailedScheduling" for e in evs)
+
+
+def test_tpu_metrics_report_fleet_utilization(stack):
+    api, mgr = stack
+    app = dashboard.create_app(api)
+    client = app.test_client(user=USER)
+    api.ensure_namespace("team")
+    for i in range(2):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    api.create(make_notebook("nb", "team", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+
+    tpu = get_json(client, "/api/metrics")["tpu"]
+    entry = tpu["tpu-v5p-slice"]
+    assert entry["nodes"] == 2
+    assert entry["allocatable"] == 8.0
+    assert entry["used"] == 8.0  # both hosts of the slice are scheduled
+
+    links = get_json(client, "/api/dashboard-links")
+    assert any(m["link"] == "/jupyter/" for m in links["menuLinks"])
+
+
+def test_get_all_namespaces_requires_cluster_admin(stack):
+    api, _ = stack
+    app = dashboard.create_app(api)
+    client = app.test_client(user=USER)
+    assert client.get("/api/workgroup/get-all-namespaces").status_code == 403
+    crb = make_object("rbac.authorization.k8s.io/v1", "ClusterRoleBinding",
+                      "root")
+    crb["roleRef"] = {"kind": "ClusterRole", "name": "cluster-admin"}
+    crb["subjects"] = [{"kind": "User", "name": USER}]
+    api.create(crb)
+    assert client.get("/api/workgroup/get-all-namespaces").status_code == 200

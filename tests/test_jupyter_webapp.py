@@ -1,0 +1,209 @@
+"""Jupyter spawner backend: authn/authz/CSRF pipeline + form→CR path
+(reference: crud_backend/authn.py:12-67, authz.py:101-133, csrf.py,
+jupyter .../form.py:74-299, routes/post.py:12-75, routes/get.py:101-126)."""
+
+import json
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import make_tpu_node
+from kubeflow_rm_tpu.controlplane.webapps.core import CSRF_HEADER
+from kubeflow_rm_tpu.controlplane.webapps.jupyter import create_app
+
+USER = "alice@corp.com"
+
+
+@pytest.fixture
+def stack():
+    api, mgr = make_control_plane()
+    api.ensure_namespace("team")
+    # alice is namespace admin (what the profile controller grants owners)
+    rb = make_object("rbac.authorization.k8s.io/v1", "RoleBinding",
+                     "ns-admin", "team")
+    rb["roleRef"] = {"kind": "ClusterRole", "name": "kubeflow-admin"}
+    rb["subjects"] = [{"kind": "User", "name": USER}]
+    api.create(rb)
+    for i in range(2):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    return api, mgr
+
+
+@pytest.fixture
+def app(stack):
+    api, _ = stack
+    return create_app(api)
+
+
+def spawn_body(**over):
+    body = {
+        "name": "mynb",
+        "image": "ghcr.io/kubeflow-rm-tpu/jupyter-jax:latest",
+        "imagePullPolicy": "IfNotPresent",
+        "serverType": "jupyter",
+        "cpu": "4",
+        "memory": "16Gi",
+        "tpu": {"acceleratorType": "v5p-16"},
+        "tolerationGroup": "none",
+        "affinityConfig": "none",
+        "configurations": [],
+        "shm": True,
+        "environment": {},
+        "datavols": [],
+        "workspace": {"mount": "/home/jovyan",
+                      "newPvc": {"metadata":
+                                 {"name": "{notebook-name}-workspace"},
+                                 "spec": {"resources":
+                                          {"requests": {"storage": "5Gi"}},
+                                          "accessModes": ["ReadWriteOnce"]}}},
+    }
+    body.update(over)
+    return body
+
+
+def post_json(client, url, body):
+    return client.post(url, data=json.dumps(body),
+                       headers=[("Content-Type", "application/json")])
+
+
+# ---- pipeline --------------------------------------------------------
+
+def test_no_user_header_is_unauthorized(app):
+    resp = app.test_client(user=None).get("/api/config")
+    assert resp.status_code == 401
+    assert json.loads(resp.get_data())["success"] is False
+
+
+def test_csrf_required_on_unsafe_methods(app):
+    client = app.test_client(user=USER)
+    # strip the CSRF header: double-submit must fail
+    resp = client._client.post(
+        "/api/namespaces/team/notebooks",
+        data="{}", headers=[("kubeflow-userid", ":" + USER),
+                            ("Content-Type", "application/json")])
+    assert resp.status_code == 403
+    assert "CSRF" in json.loads(resp.get_data())["log"]
+
+
+def test_csrf_header_must_match_cookie(app):
+    client = app.test_client(user=USER)
+    resp = client.post("/api/namespaces/team/notebooks", data="{}",
+                       headers=[(CSRF_HEADER, "wrong-token"),
+                                ("Content-Type", "application/json")])
+    assert resp.status_code == 403
+
+
+def test_authz_forbids_non_member(app):
+    client = app.test_client(user="mallory@corp.com")
+    resp = post_json(client, "/api/namespaces/team/notebooks", spawn_body())
+    assert resp.status_code == 403
+    assert "not authorized" in json.loads(resp.get_data())["log"]
+
+
+def test_healthz_needs_no_auth(app):
+    resp = app.test_client(user=None).get("/healthz")
+    assert resp.status_code == 200
+
+
+# ---- spawn path ------------------------------------------------------
+
+def test_post_spawns_tpu_notebook_end_to_end(stack, app):
+    api, mgr = stack
+    client = app.test_client(user=USER)
+    resp = post_json(client, "/api/namespaces/team/notebooks", spawn_body())
+    assert resp.status_code == 200, resp.get_data()
+
+    nb = api.get(nb_api.KIND, "mynb", "team")
+    assert nb["spec"]["tpu"] == {"acceleratorType": "v5p-16"}
+    ann = nb["metadata"]["annotations"]
+    assert ann["notebooks.kubeflow.org/creator"] == USER
+    # workspace PVC was created and mounted
+    pvc = api.get("PersistentVolumeClaim", "mynb-workspace", "team")
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "5Gi"
+    spec = nb["spec"]["template"]["spec"]
+    assert {"mountPath": "/home/jovyan", "name": "mynb-workspace"} in \
+        spec["containers"][0]["volumeMounts"]
+    # shm volume present
+    assert any(v["name"] == "dshm" for v in spec["volumes"])
+    # cpu limitFactor 1.2 applied
+    assert spec["containers"][0]["resources"]["limits"]["cpu"] == "4.8"
+
+    # reconcile: the spawned CR becomes a ready 2-host slice
+    mgr.run_until_idle()
+    listing = json.loads(client.get(
+        "/api/namespaces/team/notebooks").get_data())
+    (entry,) = listing["notebooks"]
+    assert entry["tpu"]["hosts"] == 2
+    assert entry["status"]["phase"] == "ready"
+
+
+def test_stop_and_restart_via_patch(stack, app):
+    api, mgr = stack
+    client = app.test_client(user=USER)
+    post_json(client, "/api/namespaces/team/notebooks", spawn_body())
+    mgr.run_until_idle()
+
+    client.patch("/api/namespaces/team/notebooks/mynb",
+                 data=json.dumps({"stopped": True}),
+                 headers=[("Content-Type", "application/json")])
+    mgr.run_until_idle()
+    assert api.list("Pod", "team") == []
+    entry = json.loads(client.get(
+        "/api/namespaces/team/notebooks").get_data())["notebooks"][0]
+    assert entry["status"]["phase"] == "stopped"
+
+    client.patch("/api/namespaces/team/notebooks/mynb",
+                 data=json.dumps({"stopped": False}),
+                 headers=[("Content-Type", "application/json")])
+    mgr.run_until_idle()
+    assert len(api.list("Pod", "team")) == 2
+
+
+def test_readonly_field_rejects_client_value(stack):
+    api, _ = stack
+    import yaml as _yaml
+    from kubeflow_rm_tpu.controlplane.webapps.jupyter import DEFAULT_CONFIG
+    cfg = _yaml.safe_load(open(DEFAULT_CONFIG))
+    cfg["spawnerFormDefaults"]["image"]["readOnly"] = True
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        _yaml.safe_dump(cfg, f)
+        path = f.name
+    app = create_app(api, config_path=path)
+    client = app.test_client(user=USER)
+    resp = post_json(client, "/api/namespaces/team/notebooks", spawn_body())
+    assert resp.status_code == 400
+    assert "readonly" in json.loads(resp.get_data())["log"]
+
+
+def test_api_tpus_intersects_config_with_inventory(stack, app):
+    # inventory has v5p-16 nodes only; config offers many more types
+    client = app.test_client(user=USER)
+    tpus = json.loads(client.get("/api/tpus").get_data())["tpus"]
+    assert [t["acceleratorType"] for t in tpus] == ["v5p-16"]
+    assert tpus[0]["hosts"] == 2 and tpus[0]["chips"] == 8
+
+
+def test_unknown_accelerator_type_rejected(app):
+    client = app.test_client(user=USER)
+    resp = post_json(client, "/api/namespaces/team/notebooks",
+                     spawn_body(tpu={"acceleratorType": "v99-8"}))
+    assert resp.status_code in (400, 422)
+
+
+def test_status_ladder_shows_waiting_then_warning(stack):
+    api, mgr = stack
+    app = create_app(api)
+    client = app.test_client(user=USER)
+    # ask for a slice type with no nodes: pods stay Pending ->
+    # FailedScheduling warning surfaces in the status ladder
+    resp = post_json(client, "/api/namespaces/team/notebooks",
+                     spawn_body(tpu={"acceleratorType": "v5litepod-16"}))
+    assert resp.status_code == 200, resp.get_data()
+    mgr.run_until_idle()
+    entry = json.loads(client.get(
+        "/api/namespaces/team/notebooks").get_data())["notebooks"][0]
+    assert entry["status"]["phase"] == "warning"

@@ -72,14 +72,14 @@ def test_quota_rejects_over_chip_notebook(stack):
 
     api.create(make_notebook("toobig", "dave", accelerator_type="v5p-16"))
     mgr.run_until_idle()
-    # STS exists but pod creation was quota-denied: first pod (4 chips)
-    # fits, second exceeds the namespace's 4-chip budget
+    # slice admission is all-or-nothing: the first pod (4 chips) would
+    # fit but the second exceeds the namespace's 4-chip budget, so the
+    # pre-check rejects the whole slice — zero pods, no chips held
     pods = api.list("Pod", "dave")
-    assert len(pods) < 2
+    assert pods == []
     sts = api.get("StatefulSet", "toobig", "dave")
     evs = api.events_for(sts)
-    assert any(e["reason"] == "FailedCreate" and "quota" in e["message"]
-               for e in evs), evs
+    assert any(e["reason"] == "SliceAdmissionFailed" for e in evs), evs
 
     # a right-sized notebook in the same namespace is fine
     api.delete("Notebook", "toobig", "dave")
@@ -115,3 +115,52 @@ def test_workload_identity_plugin_annotates_editor_sa(stack):
     sa = api.get("ServiceAccount", "default-editor", "frank")
     assert sa["metadata"]["annotations"]["iam.gke.io/gcp-service-account"] \
         == "train@proj.iam.gserviceaccount.com"
+
+
+def test_raised_quota_admits_rejected_slice_on_requeue():
+    """A quota-rejected slice must come up once the quota is raised —
+    the controller polls via timed requeue (nothing watches
+    ResourceQuota)."""
+    from tests.cp_fixtures import FakeClock
+
+    clock = FakeClock()
+    api, mgr = make_control_plane(clock=clock)
+    for i in range(2):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    api.create(make_profile("grace", "grace@corp.com",
+                            quota_hard={"google.com/tpu": "4"}))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+
+    api.create(make_notebook("nb", "grace", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    assert api.list("Pod", "grace") == []
+
+    quota = api.get("ResourceQuota", profile_api.QUOTA_NAME, "grace")
+    quota["spec"]["hard"]["google.com/tpu"] = "8"
+    api.update(quota)
+    clock.advance(seconds=31)
+    mgr.run_until_idle()
+    pods = api.list("Pod", "grace")
+    assert len(pods) == 2, [p["metadata"]["name"] for p in pods]
+
+
+def test_service_account_subject_does_not_leak_to_header_identity():
+    """RoleBindings to ServiceAccounts (profile controller grants
+    default-editor) must not authorize an HTTP identity literally
+    named 'default-editor' — only the system:serviceaccount rendering
+    matches (authz bypass regression)."""
+    api, mgr = make_control_plane()
+    api.create(make_profile("henry", "henry@corp.com"))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+
+    assert api.access_review("henry@corp.com", "create", "notebooks",
+                             "henry")
+    # the bypass: a user header of a bare SA name
+    assert not api.access_review("default-editor", "create", "notebooks",
+                                 "henry")
+    # the legitimate SA identity
+    assert api.access_review(
+        "system:serviceaccount:henry:default-editor", "create",
+        "notebooks", "henry")

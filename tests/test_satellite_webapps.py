@@ -1,0 +1,171 @@
+"""Volumes + Tensorboards web apps and KFAM REST service
+(reference: crud-web-apps/volumes, crud-web-apps/tensorboards,
+access-management/kfam/routers.go:32-90)."""
+
+import json
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+from kubeflow_rm_tpu.controlplane.webapps import kfam, tensorboards, volumes
+
+USER = "alice@corp.com"
+
+
+def grant_admin(api, ns, user=USER):
+    rb = make_object("rbac.authorization.k8s.io/v1", "RoleBinding",
+                     f"grant-{user.split('@')[0]}", ns)
+    rb["roleRef"] = {"kind": "ClusterRole", "name": "kubeflow-admin"}
+    rb["subjects"] = [{"kind": "User", "name": user}]
+    api.create(rb)
+
+
+@pytest.fixture
+def stack():
+    api, mgr = make_control_plane()
+    api.ensure_namespace("team")
+    grant_admin(api, "team")
+    return api, mgr
+
+
+def post_json(client, url, body):
+    return client.post(url, data=json.dumps(body),
+                       headers=[("Content-Type", "application/json")])
+
+
+# ---- volumes ---------------------------------------------------------
+
+def test_pvc_crud_and_viewer_flow(stack):
+    api, mgr = stack
+    app = volumes.create_app(api)
+    client = app.test_client(user=USER)
+
+    resp = post_json(client, "/api/namespaces/team/pvcs", {
+        "pvc": {"metadata": {"name": "data"},
+                "spec": {"accessModes": ["ReadWriteOnce"],
+                         "resources": {"requests": {"storage": "5Gi"}}}}})
+    assert resp.status_code == 200, resp.get_data()
+
+    resp = post_json(client, "/api/namespaces/team/viewers/data", {})
+    assert resp.status_code == 200
+    mgr.run_until_idle()
+    deploy = api.get("Deployment", "data-pvcviewer", "team")
+    assert "filebrowser" in deep_get(deploy, "spec", "template", "spec",
+                                     "containers", 0, "image")
+
+    listing = json.loads(client.get(
+        "/api/namespaces/team/pvcs").get_data())["pvcs"]
+    assert listing[0]["pvc"]["metadata"]["name"] == "data"
+    assert listing[0]["viewer"] is not None
+
+    # a mounted PVC cannot be deleted
+    pod = make_object("v1", "Pod", "user-pod", "team")
+    pod["spec"] = {"containers": [{"name": "c", "image": "i"}],
+                   "volumes": [{"name": "v", "persistentVolumeClaim":
+                                {"claimName": "data"}}]}
+    api.create(pod)
+    resp = client.delete("/api/namespaces/team/pvcs/data")
+    assert resp.status_code == 409
+    api.delete("Pod", "user-pod", "team")
+    resp = client.delete("/api/namespaces/team/pvcs/data")
+    assert resp.status_code == 200
+    assert api.try_get("PersistentVolumeClaim", "data", "team") is None
+    assert api.try_get("PVCViewer", "data", "team") is None
+
+
+# ---- tensorboards ----------------------------------------------------
+
+def test_tensorboard_crud(stack):
+    api, mgr = stack
+    app = tensorboards.create_app(api)
+    client = app.test_client(user=USER)
+
+    resp = post_json(client, "/api/namespaces/team/tensorboards",
+                     {"name": "tb", "logspath": "gs://bucket/logs"})
+    assert resp.status_code == 200, resp.get_data()
+    mgr.run_until_idle()
+    listing = json.loads(client.get(
+        "/api/namespaces/team/tensorboards").get_data())["tensorboards"]
+    assert listing[0]["logspath"] == "gs://bucket/logs"
+    assert listing[0]["status"]["phase"] == "ready"
+
+    resp = post_json(client, "/api/namespaces/team/tensorboards",
+                     {"name": "bad", "logspath": "/local/path"})
+    assert resp.status_code == 400
+
+    assert client.delete(
+        "/api/namespaces/team/tensorboards/tb").status_code == 200
+    assert api.try_get("Tensorboard", "tb", "team") is None
+
+
+# ---- KFAM ------------------------------------------------------------
+
+def test_kfam_contributor_binding_grants_access(stack):
+    api, _ = stack
+    app = kfam.create_app(api)
+    client = app.test_client(user=USER)
+
+    assert not api.access_review("bob@corp.com", "list", "notebooks",
+                                 "team")
+    resp = post_json(client, "/kfam/v1/bindings", {
+        "user": {"kind": "User", "name": "bob@corp.com"},
+        "referredNamespace": "team",
+        "roleRef": {"kind": "ClusterRole", "name": "edit"}})
+    assert resp.status_code == 200, resp.get_data()
+
+    # the RoleBinding it wrote is live RBAC: bob can now act in team
+    assert api.access_review("bob@corp.com", "list", "notebooks", "team")
+    # and the istio AuthorizationPolicy admits bob's identity header
+    ap = api.get("AuthorizationPolicy",
+                 kfam.binding_name("bob@corp.com", "edit"), "team")
+    when = deep_get(ap, "spec", "rules", 0, "when", 0)
+    assert when["values"] == [":bob@corp.com"]
+
+    listing = json.loads(client.get(
+        "/kfam/v1/bindings?namespace=team").get_data())["bindings"]
+    assert any(b["user"]["name"] == "bob@corp.com" for b in listing)
+
+    resp = client.open("/kfam/v1/bindings", method="DELETE",
+                       data=json.dumps({
+                           "user": {"kind": "User",
+                                    "name": "bob@corp.com"},
+                           "referredNamespace": "team",
+                           "roleRef": {"kind": "ClusterRole",
+                                       "name": "edit"}}),
+                       headers=[("Content-Type", "application/json")])
+    assert resp.status_code == 200
+    assert not api.access_review("bob@corp.com", "list", "notebooks",
+                                 "team")
+
+
+def test_kfam_profile_lifecycle_and_clusteradmin(stack):
+    api, mgr = stack
+    app = kfam.create_app(api)
+    client = app.test_client(user=USER)
+
+    resp = post_json(client, "/kfam/v1/profiles", {
+        "metadata": {"name": "alice"},
+        "spec": {"owner": {"kind": "User", "name": USER}}})
+    assert resp.status_code == 200
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    assert api.get("Namespace", "alice")
+
+    admin = json.loads(client.get(
+        "/kfam/v1/role/clusteradmin").get_data())["clusteradmin"]
+    assert admin is False
+    crb = make_object("rbac.authorization.k8s.io/v1", "ClusterRoleBinding",
+                      "alice-admin")
+    crb["roleRef"] = {"kind": "ClusterRole", "name": "cluster-admin"}
+    crb["subjects"] = [{"kind": "User", "name": USER}]
+    api.create(crb)
+    admin = json.loads(client.get(
+        "/kfam/v1/role/clusteradmin").get_data())["clusteradmin"]
+    assert admin is True
+
+    # the owner may delete their own profile
+    resp = client.delete("/kfam/v1/profiles/alice")
+    assert resp.status_code == 200
+    mgr.run_until_idle()
+    assert api.try_get("Profile", "alice") is None

@@ -81,6 +81,11 @@ def test_tensorboard_rwo_pins_to_mounting_node(stack):
     deploy = api.get("Deployment", "tb3", "ns")
     assert deep_get(deploy, "spec", "template", "spec", "nodeName") == \
         "node-a"
+    # a pre-pinned pod must still be run by the fake kubelet — the
+    # owner's readiness would otherwise hang at 0 forever
+    assert deep_get(deploy, "status", "readyReplicas") == 1
+    tb_pod = api.get("Pod", "tb3-0", "ns")
+    assert deep_get(tb_pod, "status", "phase") == "Running"
 
 
 def test_pvcviewer_renders_filebrowser(stack):

@@ -1,0 +1,11 @@
+"""SPMD launcher — the in-image process layer for multi-host slices.
+
+Worker 0 runs JupyterLab (s6 service, as in the reference's jupyter
+image); ordinals > 0 run the worker agent (``agent.py``), which joins
+``jax.distributed`` and idles until the notebook kernel on worker 0
+drives an SPMD program across the slice. The reference has no
+equivalent — its servers are single-pod (SURVEY.md §2.6)."""
+
+from kubeflow_rm_tpu.launcher.agent import WorkerAgent
+
+__all__ = ["WorkerAgent"]

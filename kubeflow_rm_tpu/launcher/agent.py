@@ -1,0 +1,116 @@
+"""Worker agent for slice ordinals > 0.
+
+On a multi-host slice the platform starts the same image on every host
+(StatefulSet, Parallel pod management). JupyterLab must run exactly
+once (worker 0: the UI Service routes there), but **every** host must
+run a jax process for SPMD programs to span the slice. This agent is
+that process for ordinals > 0:
+
+1. read the webhook-injected rendezvous env (``TPU_WORKER_ID`` /
+   ``TPU_WORKER_HOSTNAMES`` — ``parallel/distributed.py``),
+2. join ``jax.distributed`` with worker 0 as coordinator,
+3. serve ``/healthz`` (the kubelet readiness probe for peer pods —
+   the reference probes JupyterLab; peers have no Lab to probe),
+4. block until the process is terminated (slice teardown).
+
+The jax runtime handles the actual work: once initialized, worker 0's
+kernel executing a jitted computation over the full mesh makes libtpu
+run this host's shard — there is no work queue to poll. This is the
+SPMD model, not a task-dispatch model, which is why the agent is this
+small.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+
+log = logging.getLogger("kubeflow_rm_tpu.launcher")
+
+HEALTH_PORT = 8080
+
+
+class WorkerAgent:
+    def __init__(self, environ=None, *, health_port: int = HEALTH_PORT):
+        from kubeflow_rm_tpu.parallel.distributed import tpu_env
+        self.env = tpu_env(environ)
+        self.health_port = health_port
+        self._httpd = None
+        self._ready = False
+
+    @property
+    def is_worker_zero(self) -> bool:
+        return self.env.worker_id == 0
+
+    def start_health_server(self) -> int:
+        """Serve /healthz; returns the bound port (ephemeral if 0)."""
+        agent = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                body = json.dumps({
+                    "ready": agent._ready,
+                    "worker_id": agent.env.worker_id,
+                    "hosts": agent.env.num_hosts,
+                }).encode()
+                self.send_response(200 if agent._ready else 503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("0.0.0.0", self.health_port), Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self._httpd.server_address[1]
+
+    def join_slice(self) -> None:
+        """Initialize jax.distributed from the injected env (no-op on
+        single-host)."""
+        from kubeflow_rm_tpu.parallel.distributed import initialize
+        initialize(dict_env(self.env))
+        self._ready = True
+        log.info("worker %d/%d joined the slice", self.env.worker_id,
+                 self.env.num_hosts)
+
+    def run_forever(self) -> None:
+        import signal
+        stop = threading.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *a: stop.set())
+        stop.wait()
+        if self._httpd:
+            self._httpd.shutdown()
+
+
+def dict_env(env) -> dict:
+    return {
+        "TPU_WORKER_ID": str(env.worker_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(env.worker_hostnames),
+        **({"TPU_ACCELERATOR_TYPE": env.accelerator_type}
+           if env.accelerator_type else {}),
+        **({"TPU_TOPOLOGY": env.topology} if env.topology else {}),
+    }
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    agent = WorkerAgent()
+    if agent.is_worker_zero:
+        # worker 0 runs JupyterLab (separate s6 service); the agent has
+        # nothing to do — exit cleanly so s6 doesn't restart-loop it
+        log.info("worker 0: JupyterLab owns this host; agent exiting")
+        return
+    agent.start_health_server()
+    agent.join_slice()
+    agent.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Image-tree validation (SURVEY.md §2.4). No docker daemon in this
+environment, so buildability is asserted structurally: Dockerfile
+contracts, s6 service shape, the Makefile DAG, and — the BASELINE.md
+purity metric — zero CUDA anywhere in the TPU images."""
+
+import os
+import re
+from pathlib import Path
+
+import pytest
+
+IMAGES = Path(__file__).resolve().parent.parent / "images"
+TPU_IMAGES = ("jupyter-jax", "jupyter-jax-full", "jupyter-pytorch-xla")
+ALL_IMAGES = ("base", "jupyter", "jupyter-jax", "jupyter-jax-full",
+              "jupyter-pytorch-xla", "jupyter-scipy", "codeserver-python")
+
+
+def test_every_image_dir_has_parameterized_dockerfile():
+    for name in ALL_IMAGES:
+        df = (IMAGES / name / "Dockerfile").read_text()
+        assert "ARG BASE_IMG" in df, name
+        assert re.search(r"FROM \$BASE_IMG", df), name
+
+
+def test_tpu_images_have_no_cuda_layer():
+    """North-star purity: no CUDA/cuDNN/NVIDIA anywhere in the TPU
+    image definitions (BASELINE.md 'image purity')."""
+    for name in TPU_IMAGES:
+        for path in (IMAGES / name).rglob("*"):
+            if path.is_file():
+                effective = "\n".join(
+                    line for line in path.read_text().lower().splitlines()
+                    if not line.lstrip().startswith("#"))
+                for banned in ("cuda", "cudnn", "nvidia"):
+                    assert banned not in effective, (path, banned)
+
+
+def test_flagship_image_ships_libtpu_jax_and_library():
+    df = (IMAGES / "jupyter-jax" / "Dockerfile").read_text()
+    assert "jax[tpu]" in df
+    assert "libtpu_releases.html" in df
+    assert "kubeflow_rm_tpu/" in df  # compute library baked in
+
+
+def test_s6_services_have_contenv_shebang_and_exec_bit():
+    runs = list(IMAGES.rglob("s6/services.d/*/run")) + \
+        list(IMAGES.rglob("s6/cont-init.d/*"))
+    assert runs, "no s6 scripts found"
+    for script in runs:
+        text = script.read_text()
+        assert text.startswith("#!/command/with-contenv bash"), script
+        assert os.access(script, os.X_OK), f"{script} not executable"
+
+
+def test_multihost_service_split():
+    """Worker 0 runs Lab; ordinals > 0 run the agent — both encoded in
+    the s6 services so one image serves every slice role."""
+    lab = (IMAGES / "jupyter" / "s6/services.d/jupyterlab/run").read_text()
+    assert 'TPU_WORKER_ID' in lab and "sleep infinity" in lab
+    agent = (IMAGES / "jupyter-jax" /
+             "s6/services.d/worker-agent/run").read_text()
+    assert "kubeflow_rm_tpu.launcher.agent" in agent
+
+
+def test_makefile_covers_every_image_with_correct_parents():
+    mk = (IMAGES / "Makefile").read_text()
+    for name in ALL_IMAGES:
+        assert re.search(rf"^{re.escape(name)}:", mk, re.M), name
+    # DAG edges
+    assert re.search(r"^jupyter: base", mk, re.M)
+    assert re.search(r"^jupyter-jax: jupyter", mk, re.M)
+    assert re.search(r"^jupyter-jax-full: jupyter-jax", mk, re.M)
+    assert re.search(r"^codeserver-python: base", mk, re.M)
+
+
+def test_worker_agent_module_runs():
+    """The module the s6 service execs exists and behaves: worker 0
+    exits; a peer binds health and reports not-ready until joined."""
+    from kubeflow_rm_tpu.launcher.agent import WorkerAgent
+
+    zero = WorkerAgent({"TPU_WORKER_ID": "0", "TPU_WORKER_HOSTNAMES": ""})
+    assert zero.is_worker_zero
+
+    peer = WorkerAgent(
+        {"TPU_WORKER_ID": "1",
+         "TPU_WORKER_HOSTNAMES": "a.svc,b.svc"},
+        health_port=0)
+    assert not peer.is_worker_zero
+    port = peer.start_health_server()
+    import json
+    import urllib.request
+    try:
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+        peer._ready = True  # join_slice() would set this
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as r:
+            body = json.load(r)
+        assert body == {"ready": True, "worker_id": 1, "hosts": 2}
+    finally:
+        peer._httpd.shutdown()

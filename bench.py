@@ -40,12 +40,15 @@ def main() -> None:
     on_tpu = platform == "tpu"
 
     if on_tpu:
-        # ~1.2B params; bf16 params + full remat keep state (~7 G) plus
-        # live activations under a v5e's 16 GiB HBM (fp32 master/moments
-        # or the save-dots policy would not fit)
+        # ~1.2B params, bf16 state (~7 G). Best measured config on a
+        # 16 GiB v5e: batch 2 with the "attn+mlp" named-save remat
+        # policy — backward recomputes only norms, and the pallas flash
+        # kernel keeps scores out of HBM (42.8% MFU vs 35.2% for
+        # batch 4 + full remat; larger batches force leaner policies
+        # and lose more to recompute than they gain in utilization).
         model = LlamaConfig.bench_1b(param_dtype=jnp.bfloat16,
-                                     remat_policy="full")
-        batch, steps, warmup = 4, 10, 2
+                                     remat_policy="attn+mlp")
+        batch, steps, warmup = 2, 10, 2
     else:
         model = LlamaConfig.tiny()
         batch, steps, warmup = 8, 6, 2

@@ -70,11 +70,35 @@ class WorkerAgent:
                          daemon=True).start()
         return self._httpd.server_address[1]
 
-    def join_slice(self) -> None:
+    def join_slice(self, *, retry_interval_s: float = 15.0,
+                   max_attempts: int | None = None) -> None:
         """Initialize jax.distributed from the injected env (no-op on
-        single-host)."""
+        single-host).
+
+        Retries until the coordinator appears: worker 0 only starts the
+        jax coordinator when the user's notebook kernel initializes,
+        which can be minutes-to-hours after peer pods boot — a single
+        timed-out attempt would crash the agent and leave slice
+        assembly to luck (whether an s6 restart overlaps the kernel's
+        init window). ``max_attempts`` bounds the loop for tests.
+        """
         from kubeflow_rm_tpu.parallel.distributed import initialize
-        initialize(dict_env(self.env))
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                initialize(dict_env(self.env))
+                break
+            except Exception as e:
+                if max_attempts is not None and attempt >= max_attempts:
+                    raise
+                log.info(
+                    "worker %d: coordinator %s not up yet (attempt %d: "
+                    "%s); retrying in %.0fs", self.env.worker_id,
+                    self.env.worker_hostnames[:1], attempt, e,
+                    retry_interval_s)
+                import time
+                time.sleep(retry_interval_s)
         self._ready = True
         log.info("worker %d/%d joined the slice", self.env.worker_id,
                  self.env.num_hosts)

@@ -56,9 +56,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
-    # "dots": save matmul outputs (fastest bwd, ~L× activation memory);
-    # "full": save only the scan carry and recompute the block (O(1)
-    # live layers — what a 16 GiB v5e needs for the 1B bench config).
+    # "dots": save all matmul outputs (fastest bwd, ~L× activation
+    # memory); "full": save only the scan carry and recompute the block;
+    # "attn"/"mlp"/"attn+mlp": save the named activations only (the
+    # HBM-vs-recompute middle ground — see _NAME_POLICIES).
     remat_policy: str = "dots"
 
     @property
@@ -107,6 +108,32 @@ class LlamaConfig:
         )
 
 
+#: named-tensor remat presets: save the listed activations, recompute
+#: the rest in backward. Sizes per layer (B=4, T=2048, bench_1b):
+#: qkv+attn 4x32 MB; mlp gate/up 92 MB each. "attn" skips recomputing
+#: the attention pipeline (projections + rope + flash fwd) for ~1.9 GB;
+#: "attn+mlp" also skips the two F-sized matmuls for ~3.7 GB more.
+_NAME_POLICIES = {
+    "attn": ("q_rope", "k_rope", "v_proj", "attn_out"),
+    "attn+mlp": ("q_rope", "k_rope", "v_proj", "attn_out",
+                 "mlp_gate", "mlp_up"),
+    "mlp": ("mlp_gate", "mlp_up"),
+}
+
+
+def _remat_policy(name: str):
+    if name == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "dots":
+        return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    if name in _NAME_POLICIES:
+        return jax.checkpoint_policies.save_only_these_names(
+            *_NAME_POLICIES[name])
+    raise ValueError(
+        f"remat_policy must be one of "
+        f"{sorted(['full', 'dots', *_NAME_POLICIES])}, got {name!r}")
+
+
 def param_spec_shapes(cfg: LlamaConfig) -> dict:
     """Abstract shapes of the parameter pytree (layer-stacked)."""
     L, D, V = cfg.n_layers, cfg.dim, cfg.vocab_size
@@ -151,7 +178,13 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
 
 
 def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
-    """One transformer block. x: (B, T, D) in compute dtype."""
+    """One transformer block. x: (B, T, D) in compute dtype.
+
+    Activations are tagged with ``checkpoint_name`` so remat policies
+    can save exactly the tensors whose recompute is expensive relative
+    to their HBM cost (see ``LlamaConfig.remat_policy``)."""
+    from jax.ad_checkpoint import checkpoint_name
+
     B, T, D = x.shape
     H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     cdt = cfg.dtype
@@ -160,17 +193,19 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
     q = (h @ layer["wq"].astype(cdt)).reshape(B, T, H, hd)
     k = (h @ layer["wk"].astype(cdt)).reshape(B, T, KVH, hd)
     v = (h @ layer["wv"].astype(cdt)).reshape(B, T, KVH, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    q = checkpoint_name(apply_rope(q, cos, sin), "q_rope")
+    k = checkpoint_name(apply_rope(k, cos, sin), "k_rope")
+    v = checkpoint_name(v, "v_proj")
     attn = dot_product_attention(
         q, k, v, causal=True, positions_q=positions, positions_kv=positions,
         segment_ids_q=segments, segment_ids_kv=segments,
     )
+    attn = checkpoint_name(attn, "attn_out")
     x = x + attn.reshape(B, T, H * hd) @ layer["wo"].astype(cdt)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = h @ layer["w_gate"].astype(cdt)
-    up = h @ layer["w_up"].astype(cdt)
+    gate = checkpoint_name(h @ layer["w_gate"].astype(cdt), "mlp_gate")
+    up = checkpoint_name(h @ layer["w_up"].astype(cdt), "mlp_up")
     x = x + (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cdt)
     return x
 
@@ -199,6 +234,13 @@ def forward(
     """
     B, T = tokens.shape
     cdt = cfg.dtype
+    # attention only needs explicit positions when the caller supplies
+    # non-contiguous ones (sequence-parallel shards); the default arange
+    # is exactly local-index causality, and leaving attn_positions=None
+    # keeps the call eligible for the pallas flash kernel. Packed
+    # sequences pass positions for RoPE but their mask is fully captured
+    # by local-causal ∧ segments (see ops/flash_attention.py).
+    attn_positions = None if segments is not None else positions
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
@@ -209,18 +251,10 @@ def forward(
 
     block = partial(_block, cfg)
     if cfg.remat:
-        policies = {
-            "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            "full": jax.checkpoint_policies.nothing_saveable,
-        }
-        if cfg.remat_policy not in policies:
-            raise ValueError(
-                f"remat_policy must be one of {sorted(policies)}, "
-                f"got {cfg.remat_policy!r}")
-        block = jax.checkpoint(block, policy=policies[cfg.remat_policy])
+        block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
 
     def scan_body(x, layer):
-        return block(x, layer, cos, sin, positions, segments), None
+        return block(x, layer, cos, sin, attn_positions, segments), None
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
 

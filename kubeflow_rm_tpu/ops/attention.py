@@ -52,6 +52,27 @@ def attention_mask(
     return mask
 
 
+def flash_eligible(q, k, *, causal, positions_q, bias) -> bool:
+    """Can the pallas flash kernel handle this call exactly?
+
+    Requires: causal self-attention over local indices (no explicit
+    positions — packed sequences are covered because local-causal ∧
+    same-segment ≡ position-causal ∧ same-segment, see
+    ``flash_attention`` docstring), no additive bias, and shapes that
+    tile the block sizes the kernel will actually pick.
+    """
+    from kubeflow_rm_tpu.ops.flash_attention import (
+        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+    )
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq = min(DEFAULT_BLOCK_Q, Tq)
+    bk = min(DEFAULT_BLOCK_K, Tk)
+    return (causal and bias is None and positions_q is None
+            and Tq == Tk and Tq % bq == 0 and Tq % bk == 0
+            and D % 8 == 0)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -63,6 +84,7 @@ def dot_product_attention(
     segment_ids_q: jax.Array | None = None,
     segment_ids_kv: jax.Array | None = None,
     bias: jax.Array | None = None,
+    impl: str = "auto",
 ) -> jax.Array:
     """Scaled dot-product attention.
 
@@ -77,9 +99,33 @@ def dot_product_attention(
         packed sequences; attention is restricted to equal segments.
       bias: optional additive bias broadcastable to (B, H, Tq, Tk).
 
+      impl: "auto" (flash on TPU when exactly representable, else XLA),
+        "flash" (force the pallas kernel; interpreter off-TPU), or
+        "xla" (always the materialized-scores path).
+
     Returns:
       (B, Tq, H, D) in q.dtype.
     """
+    if impl not in ("auto", "flash", "xla"):
+        raise ValueError(f"impl must be auto|flash|xla, got {impl!r}")
+    if impl == "flash" and (bias is not None or positions_q is not None):
+        raise ValueError(
+            "impl='flash' cannot represent an additive bias or explicit "
+            "positions; use impl='xla' (packed sequences need only "
+            "segment ids — see ops/flash_attention.py)")
+    use_flash = (
+        impl == "flash"
+        or (impl == "auto"
+            and jax.default_backend() == "tpu"
+            and flash_eligible(q, k, causal=causal,
+                               positions_q=positions_q, bias=bias))
+    )
+    if use_flash:
+        from kubeflow_rm_tpu.ops.flash_attention import flash_attention
+        return flash_attention(
+            q, k, v, causal=causal,
+            segment_ids_q=segment_ids_q, segment_ids_kv=segment_ids_kv)
+
     B, Tq, H, D = q.shape
     _, Tk, KVH, _ = k.shape
     assert H % KVH == 0, f"n_heads {H} not divisible by n_kv_heads {KVH}"

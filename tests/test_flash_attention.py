@@ -1,0 +1,163 @@
+"""Pallas flash attention vs the dense XLA path (fwd + grads), on the
+pallas interpreter (CPU conftest). The kernel must be bit-compatible in
+semantics with ``dot_product_attention``: causal, GQA, and packed
+segments."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_rm_tpu.ops.attention import dot_product_attention
+from kubeflow_rm_tpu.ops.flash_attention import flash_attention
+
+
+def make_qkv(key, B=2, T=256, H=4, KVH=2, D=16):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, KVH, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, KVH, D), jnp.float32)
+    return q, k, v
+
+
+def test_flash_matches_dense_causal():
+    q, k, v = make_qkv(jax.random.key(0))
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_matches_dense_noncausal():
+    q, k, v = make_qkv(jax.random.key(1))
+    ref = dot_product_attention(q, k, v, causal=False, impl="xla")
+    out = flash_attention(q, k, v, causal=False, block_q=128,
+                          block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gradients_match_dense():
+    q, k, v = make_qkv(jax.random.key(2), B=1, T=128, H=2, KVH=2, D=8)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=64,
+                               block_k=64).sum()
+
+    def loss_ref(q, k, v):
+        return dot_product_attention(q, k, v, causal=True,
+                                     impl="xla").sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_gqa_gradients():
+    q, k, v = make_qkv(jax.random.key(3), B=1, T=128, H=4, KVH=1, D=8)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True, block_q=64,
+                                block_k=64) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=True,
+                                      impl="xla") ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_packed_segments_match_dense():
+    """Packed documents: local-causal ∧ same-segment in the kernel must
+    equal position-causal ∧ same-segment in the dense path."""
+    from kubeflow_rm_tpu.training.data import pack_documents
+
+    rng = np.random.default_rng(0)
+    docs = [rng.integers(1, 50, size=n).tolist()
+            for n in (40, 70, 25, 90, 60)]
+    packed = pack_documents(docs, seq_len=128)
+    seg = jnp.asarray(packed["segments"][:1])
+    pos = jnp.asarray(packed["positions"][:1])
+
+    q, k, v = make_qkv(jax.random.key(4), B=1, T=128, H=2, KVH=2, D=8)
+    ref = dot_product_attention(
+        q, k, v, causal=True, positions_q=pos, positions_kv=pos,
+        segment_ids_q=seg, segment_ids_kv=seg, impl="xla")
+    out = flash_attention(q, k, v, causal=True, segment_ids_q=seg,
+                          segment_ids_kv=seg, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_impl_flag_validation():
+    q, k, v = make_qkv(jax.random.key(5), B=1, T=128, H=2, KVH=2, D=8)
+    with pytest.raises(ValueError):
+        dot_product_attention(q, k, v, impl="magic")
+    # impl="flash" forces the kernel even off-TPU (interpreter)
+    out = dot_product_attention(q, k, v, causal=True, impl="flash")
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_llama_forward_with_flash_matches_xla():
+    """End-to-end: the model's attention calls route through the same
+    math whether flash or XLA executes them."""
+    from kubeflow_rm_tpu.models import LlamaConfig, forward, init_params
+
+    cfg = LlamaConfig.tiny(max_seq_len=128)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 128), 0,
+                                cfg.vocab_size)
+    ref = forward(params, tokens, cfg)
+
+    import kubeflow_rm_tpu.models.llama as llama_mod
+    from kubeflow_rm_tpu.ops import attention as attn_mod
+    orig = attn_mod.dot_product_attention
+
+    def forced_flash(*args, **kw):
+        kw["impl"] = "flash"
+        return orig(*args, **kw)
+
+    llama_mod.dot_product_attention = forced_flash
+    try:
+        out = forward(params, tokens, cfg)
+    finally:
+        llama_mod.dot_product_attention = orig
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_auto_eligibility_mirrors_kernel_blocks():
+    """auto must not select flash for shapes the kernel would reject
+    (T that tiles 128 but not the actual default block size)."""
+    from kubeflow_rm_tpu.ops.attention import flash_eligible
+    from kubeflow_rm_tpu.ops.flash_attention import DEFAULT_BLOCK_Q
+
+    T_bad = DEFAULT_BLOCK_Q + 128  # tiles 128, not DEFAULT_BLOCK_Q
+    q = jnp.zeros((1, T_bad, 2, 8))
+    k = jnp.zeros((1, T_bad, 2, 8))
+    assert not flash_eligible(q, k, causal=True, positions_q=None,
+                              bias=None)
+    q = jnp.zeros((1, DEFAULT_BLOCK_Q * 2, 2, 8))
+    k = jnp.zeros((1, DEFAULT_BLOCK_Q * 2, 2, 8))
+    assert flash_eligible(q, k, causal=True, positions_q=None, bias=None)
+
+
+def test_forced_flash_rejects_bias_and_positions():
+    q, k, v = make_qkv(jax.random.key(6), B=1, T=128, H=2, KVH=2, D=8)
+    pos = jnp.broadcast_to(jnp.arange(128), (1, 128))
+    with pytest.raises(ValueError, match="cannot represent"):
+        dot_product_attention(q, k, v, impl="flash", positions_q=pos,
+                              positions_kv=pos)
+    with pytest.raises(ValueError, match="cannot represent"):
+        dot_product_attention(q, k, v, impl="flash",
+                              bias=jnp.zeros((1, 2, 128, 128)))

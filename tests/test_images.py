@@ -99,3 +99,46 @@ def test_worker_agent_module_runs():
         assert body == {"ready": True, "worker_id": 1, "hosts": 2}
     finally:
         peer._httpd.shutdown()
+
+
+def test_worker_agent_retries_until_coordinator():
+    """Peers must outwait a coordinator that only appears when the
+    user's kernel initializes — a timed-out attempt retries instead of
+    crash-looping the s6 service."""
+    from kubeflow_rm_tpu.launcher.agent import WorkerAgent
+
+    peer = WorkerAgent({"TPU_WORKER_ID": "1",
+                        "TPU_WORKER_HOSTNAMES": "a.svc,b.svc"})
+    calls = []
+
+    import kubeflow_rm_tpu.parallel.distributed as dist
+    orig = dist.initialize
+
+    def flaky(environ):
+        calls.append(environ)
+        if len(calls) < 3:
+            raise RuntimeError("coordinator not reachable")
+        return dist.tpu_env(environ)
+
+    dist.initialize = flaky
+    try:
+        peer.join_slice(retry_interval_s=0.0, max_attempts=5)
+    finally:
+        dist.initialize = orig
+    assert len(calls) == 3 and peer._ready
+
+    # bounded attempts surface the failure for tests/ops
+    calls.clear()
+    dist.initialize = flaky
+    try:
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError):
+            peer.join_slice(retry_interval_s=0.0, max_attempts=2)
+    finally:
+        dist.initialize = orig
+
+
+def test_base_image_s6_arch_follows_targetarch():
+    df = (IMAGES / "base" / "Dockerfile").read_text()
+    assert "S6_ARCH=x86_64" in df and "S6_ARCH=aarch64" in df
+    assert "s6-overlay-${S6_ARCH}.tar.xz" in df

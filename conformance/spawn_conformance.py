@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Spawn-path conformance + load test.
+
+The reference ships a load-test seed that mass-spawns notebook servers
+(``notebook-controller/loadtest/start_notebooks.py`` +
+``jupyter_test.yaml``) and a conformance harness shape
+(``conformance/1.7``). This script is both for the TPU stack: it boots
+the full control plane against a fake TPU fleet, drives the #1 call
+stack (SURVEY.md §3.1) through the REAL web API N times — authn,
+CSRF, authz, form→CR, webhook mutation, reconcile, scheduling,
+rendezvous env — and asserts every slice comes up whole, printing
+provisioning latency stats (reconcile counts stand in for wall time on
+the in-memory apiserver).
+
+Usage:
+    python conformance/spawn_conformance.py --slices v5p-16=2 --notebooks 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from kubeflow_rm_tpu.controlplane import make_control_plane  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api.profile import make_profile  # noqa: E402
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (  # noqa: E402
+    make_tpu_node,
+)
+from kubeflow_rm_tpu.controlplane.webapps import jupyter as jwa  # noqa: E402
+
+USER = "conformance@corp.com"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slices", default="v5p-16=2",
+                    help="comma list of acceleratorType=count node pools")
+    ap.add_argument("--notebooks", type=int, default=3)
+    args = ap.parse_args()
+
+    api, mgr = make_control_plane()
+
+    # fake fleet: enough hosts for every requested slice
+    pools = []
+    for spec in args.slices.split(","):
+        accel, count = spec.split("=")
+        pools.append((accel, int(count)))
+        topo = tpu_api.lookup(accel)
+        for s in range(int(count)):
+            for h in range(topo.hosts):
+                api.create(make_tpu_node(f"{accel}-s{s}-h{h}", accel))
+
+    # namespace via the profile path (RBAC comes from the controller)
+    api.create(make_profile("conformance", USER))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+
+    app = jwa.create_app(api)
+    client = app.test_client(user=USER)
+    accel = pools[0][0]
+    topo = tpu_api.lookup(accel)
+
+    latencies = []
+    t_start = time.perf_counter()
+    for i in range(args.notebooks):
+        body = {
+            "name": f"conf-{i}",
+            "image": "ghcr.io/kubeflow-rm-tpu/jupyter-jax:latest",
+            "imagePullPolicy": "IfNotPresent", "serverType": "jupyter",
+            "cpu": "2", "memory": "8Gi",
+            "tpu": {"acceleratorType": accel},
+            "tolerationGroup": "none", "affinityConfig": "none",
+            "configurations": [], "shm": True, "environment": {},
+            "datavols": [],
+        }
+        t0 = time.perf_counter()
+        resp = client.post(
+            f"/api/namespaces/conformance/notebooks",
+            data=json.dumps(body),
+            headers=[("Content-Type", "application/json")])
+        assert resp.status_code == 200, resp.get_data()
+        reconciles = mgr.run_until_idle()
+        latencies.append((time.perf_counter() - t0, reconciles))
+
+        nb = api.get(nb_api.KIND, f"conf-{i}", "conformance")
+        ready = nb.get("status", {}).get("readyReplicas", 0)
+        pods = [p for p in api.list("Pod", "conformance")
+                if (p["metadata"].get("labels") or {}).get(
+                    nb_api.NOTEBOOK_NAME_LABEL) == f"conf-{i}"]
+        if i * topo.hosts + topo.hosts <= sum(
+                c * tpu_api.lookup(a).hosts for a, c in pools):
+            assert ready == topo.hosts, (
+                f"conf-{i}: {ready}/{topo.hosts} ready")
+            envs = [
+                {e["name"] for c in p["spec"]["containers"]
+                 for e in c.get("env", [])}
+                for p in pods
+            ]
+            for env in envs:
+                assert {"TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"} <= env
+        else:
+            # fleet exhausted: the slice must be Pending whole, not rump
+            assert ready == 0, f"conf-{i}: rump slice with {ready} ready"
+
+    total = time.perf_counter() - t_start
+    p50 = sorted(t for t, _ in latencies)[len(latencies) // 2]
+    print(json.dumps({
+        "notebooks": args.notebooks,
+        "slice": accel,
+        "hosts_per_slice": topo.hosts,
+        "provision_p50_ms": round(p50 * 1e3, 1),
+        "total_s": round(total, 2),
+        "reconciles_per_spawn": [r for _, r in latencies],
+    }))
+    print("CONFORMANCE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

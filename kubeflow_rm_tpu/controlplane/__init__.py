@@ -72,11 +72,15 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     from kubeflow_rm_tpu.controlplane.controllers.authcompanion import (
         AuthCompanionController,
     )
+    from kubeflow_rm_tpu.controlplane.controllers.slicehealth import (
+        SliceHealthController,
+    )
 
     manager = Manager(api)
     manager.add(NotebookController())
     manager.add(LockReleaseController())
     manager.add(AuthCompanionController())
+    manager.add(SliceHealthController())
     manager.add(StatefulSetController(auto_ready=auto_ready))
     manager.add(DeploymentController(auto_ready=auto_ready))
     manager.add(ProfileController())

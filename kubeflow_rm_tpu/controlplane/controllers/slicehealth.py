@@ -1,0 +1,86 @@
+"""Slice health: failure detection + whole-slice restart.
+
+The reference's recovery story is level-triggered reconciliation of a
+single pod (SURVEY.md §5: "Elasticity is only replicas 0↔1"). A TPU
+slice changes the failure calculus: an SPMD program spans every host,
+so ONE failed/preempted worker wedges the other N−1 — they hold chips,
+the jax collectives block, and nothing recovers until all N pods
+restart together. This controller supplies the missing semantic:
+
+- a Failed pod (OOM-kill, preemption, node drain) in a multi-host
+  slice ⇒ delete EVERY pod of the slice at once; the StatefulSet
+  controller re-creates all ordinals in parallel and the workers
+  re-rendezvous from a clean state;
+- a vanished pod (count < hosts while peers still run) ⇒ same
+  whole-slice restart — a rump slice is never left holding chips;
+- single-host notebooks keep the reference behavior: delete just the
+  failed pod and let it come back.
+
+Events (``SliceRestart``) make the restart visible in the UI's
+activity feed, the way the reference re-emits scheduling failures
+(``notebook_controller.go:94-123``).
+"""
+
+from __future__ import annotations
+
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, name_of
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer, NotFound
+from kubeflow_rm_tpu.controlplane.runtime import Controller, Request
+
+
+def _map_pod_to_notebook(pod: dict):
+    label = (pod["metadata"].get("labels") or {}).get(
+        nb_api.NOTEBOOK_NAME_LABEL)
+    if not label:
+        return []
+    return [Request(pod["metadata"].get("namespace"), label)]
+
+
+class SliceHealthController(Controller):
+    kind = nb_api.KIND
+
+    def watches(self):
+        return (("Pod", _map_pod_to_notebook),)
+
+    def reconcile(self, api: APIServer, req: Request):
+        try:
+            nb = api.get(self.kind, req.name, req.namespace)
+        except NotFound:
+            return None
+        if nb_api.STOP_ANNOTATION in (
+                nb["metadata"].get("annotations") or {}):
+            return None  # stopped/culled: drained pods are expected
+
+        topo = nb_api.tpu_spec(nb)
+        hosts = topo.hosts if topo else 1
+        pods = [
+            p for p in api.list("Pod", req.namespace)
+            if (p["metadata"].get("labels") or {}).get(
+                nb_api.NOTEBOOK_NAME_LABEL) == req.name
+            and not p["metadata"].get("deletionTimestamp")
+        ]
+        failed = [p for p in pods
+                  if deep_get(p, "status", "phase") == "Failed"]
+        running = [p for p in pods
+                   if deep_get(p, "status", "phase") == "Running"]
+
+        if hosts == 1:
+            # reference behavior: recycle just the failed pod
+            for p in failed:
+                api.delete("Pod", name_of(p), req.namespace)
+            return None
+
+        unhealthy = bool(failed) or (running and len(pods) < hosts)
+        if not unhealthy:
+            return None
+
+        reason = (f"{len(failed)} failed pod(s)" if failed else
+                  f"only {len(pods)}/{hosts} pods present")
+        api.record_event(
+            nb, "Warning", "SliceRestart",
+            f"TPU slice unhealthy ({reason}); restarting all {hosts} "
+            "hosts — a slice recovers whole or not at all")
+        for p in pods:
+            api.delete("Pod", name_of(p), req.namespace)
+        return None

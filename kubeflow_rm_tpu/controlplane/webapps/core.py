@@ -75,6 +75,7 @@ class WebApp:
         self._no_csrf: set[str] = set()
         self.route("/healthz", no_auth=True, no_csrf=True)(_healthz)
         self.route("/readyz", no_auth=True, no_csrf=True)(_healthz)
+        self.route("/metrics", no_auth=True, no_csrf=True)(_metrics)
 
     # ---- routing -----------------------------------------------------
     def route(self, rule: str, methods=("GET",), *, no_auth: bool = False,
@@ -245,6 +246,13 @@ def _json_response(body: dict, status: int = 200) -> Response:
 
 def _healthz(req: Request):
     return {"status": 200, "success": True, "alive": True}
+
+
+def _metrics(req: Request):
+    """Prometheus exposition (the reference serves :8080/metrics from
+    every controller — pkg/metrics/metrics.go, kfam/monitoring.go)."""
+    from kubeflow_rm_tpu.controlplane import metrics
+    return Response(metrics.scrape(), mimetype="text/plain")
 
 
 def json_body(req: Request) -> dict:

@@ -89,10 +89,19 @@ class WorkerAgent:
             try:
                 initialize(dict_env(self.env))
                 break
+            except (ValueError, TypeError):
+                # malformed rendezvous env: no amount of waiting fixes
+                # it — crash so s6/kubernetes surface the misconfig
+                raise
             except Exception as e:
                 if max_attempts is not None and attempt >= max_attempts:
                     raise
-                log.info(
+                # transient (coordinator not up, DNS settling): retry,
+                # but escalate to WARNING once it stops looking like a
+                # normal kernel-start delay so a wedged slice is loud
+                level = logging.INFO if attempt <= 8 else logging.WARNING
+                log.log(
+                    level,
                     "worker %d: coordinator %s not up yet (attempt %d: "
                     "%s); retrying in %.0fs", self.env.worker_id,
                     self.env.worker_hostnames[:1], attempt, e,

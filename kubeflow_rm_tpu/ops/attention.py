@@ -117,6 +117,14 @@ def dot_product_attention(
         impl == "flash"
         or (impl == "auto"
             and jax.default_backend() == "tpu"
+            # single-device only: pallas_call has no GSPMD partitioning
+            # rule, so under a multi-chip jit the compiler would
+            # all-gather the FULL global q/k/v onto every device —
+            # silently defeating dp/fsdp/sp sharding. Multi-chip meshes
+            # keep the einsum path (partitions cleanly) or use the ring
+            # schedules; shard_map-wrapping the kernel is the follow-up
+            # that lifts this gate.
+            and jax.device_count() == 1
             and flash_eligible(q, k, causal=causal,
                                positions_q=positions_q, bias=bias))
     )

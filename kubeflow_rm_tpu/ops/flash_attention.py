@@ -38,6 +38,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -219,6 +220,16 @@ def _flash_bwd_rule(causal, block_q, block_k, group, interpret, res, do):
 
     dS = P ∘ (dP − δ) with P = exp(S − lse), dP = dO·Vᵀ,
     δ = rowsum(dO ∘ O); dQ = dS·K, dK = dSᵀ·Q, dV = Pᵀ·dO.
+
+    Deliberately a RECTANGULAR scan over kv blocks (each step contracts
+    the full (T × blk) panel) even though causal masking wastes ~half
+    its FLOPs on future blocks. The "obvious" fix — a triangular
+    (q-tile × kv-tile) scan visiting only qb ≥ jb pairs — was measured
+    SLOWER on the v5e bench (36.7% vs 42.7% MFU end-to-end): it
+    serializes nb(nb+1)/2 small matmuls and adds read-modify-write
+    accumulator traffic, losing more to MXU underutilization than the
+    skipped FLOPs save. Big dumb panels win; revisit only inside a
+    hand-scheduled pallas backward kernel.
     """
     q, k, v, segq, segkv, out, lse = res
     B, Hq, T, D = q.shape
@@ -226,42 +237,38 @@ def _flash_bwd_rule(causal, block_q, block_k, group, interpret, res, do):
     scale = D ** -0.5
     kr = jnp.repeat(k, group, axis=1)          # (B, Hq, T, D) — see note
     vr = jnp.repeat(v, group, axis=1)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                   # (B, Hq, T)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B, Hq, T)
 
     nk = T // block_k
     rows = jnp.arange(T)
 
     def kv_block(carry, jb):
         dq_acc, dk_acc, dv_acc = carry
-        ks = jax.lax.dynamic_slice_in_dim(kr, jb * block_k, block_k, 2)
-        vs = jax.lax.dynamic_slice_in_dim(vr, jb * block_k, block_k, 2)
-        cols = jb * block_k + jnp.arange(block_k)
+        k0 = jb * block_k
+        ks = jax.lax.dynamic_slice_in_dim(kr, k0, block_k, 2)
+        vs = jax.lax.dynamic_slice_in_dim(vr, k0, block_k, 2)
+        cols = k0 + jnp.arange(block_k)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, ks,
                        preferred_element_type=jnp.float32) * scale
         mask = None
         if causal:
-            mask = rows[:, None] >= cols[None, :]
-            mask = mask[None, None]
+            mask = (rows[:, None] >= cols[None, :])[None, None]
         if segq is not None:
-            segk = jax.lax.dynamic_slice_in_dim(segkv, jb * block_k,
-                                                block_k, 1)
-            seg = (segq[:, :, None] == segk[:, None, :])[:, None]
+            sk = jax.lax.dynamic_slice_in_dim(segkv, k0, block_k, 1)
+            seg = (segq[:, :, None] == sk[:, None, :])[:, None]
             mask = seg if mask is None else mask & seg
         p = jnp.exp(s - lse[..., None])
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do.astype(jnp.float32),
-                        vs.astype(jnp.float32))
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vs.astype(jnp.float32))
         ds = p * (dp - delta[..., None]) * scale
         dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds,
                                      ks.astype(jnp.float32))
         dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
-        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, do.astype(jnp.float32))
-        dk_acc = jax.lax.dynamic_update_slice_in_dim(
-            dk_acc, dk_b, jb * block_k, 2)
-        dv_acc = jax.lax.dynamic_update_slice_in_dim(
-            dv_acc, dv_b, jb * block_k, 2)
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dk_acc = jax.lax.dynamic_update_slice_in_dim(dk_acc, dk_b, k0, 2)
+        dv_acc = jax.lax.dynamic_update_slice_in_dim(dv_acc, dv_b, k0, 2)
         return (dq_acc, dk_acc, dv_acc), None
 
     zeros_q = jnp.zeros((B, Hq, T, D), jnp.float32)

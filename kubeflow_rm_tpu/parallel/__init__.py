@@ -4,7 +4,16 @@ from kubeflow_rm_tpu.parallel.sharding import (
     param_pspecs,
     param_shardings,
 )
-from kubeflow_rm_tpu.parallel.ring_attention import ring_attention
+from kubeflow_rm_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_self_attention,
+)
+from kubeflow_rm_tpu.parallel.zigzag_ring import (
+    zigzag_permutation,
+    zigzag_positions,
+    zigzag_ring_attention,
+    zigzag_ring_self_attention,
+)
 
 __all__ = [
     "MeshConfig",
@@ -13,4 +22,9 @@ __all__ = [
     "param_pspecs",
     "param_shardings",
     "ring_attention",
+    "ring_self_attention",
+    "zigzag_permutation",
+    "zigzag_positions",
+    "zigzag_ring_attention",
+    "zigzag_ring_self_attention",
 ]

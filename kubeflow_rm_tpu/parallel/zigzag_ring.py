@@ -1,0 +1,204 @@
+"""Zigzag ring attention: causal sequence parallelism without waste.
+
+The plain ring schedule (``ring_attention.py``) rotates every K/V chunk
+through every device and *masks* fully-future blocks: with contiguous
+chunks, device 0 needs only 1 of n visiting chunks while device n−1
+needs all n, so under SPMD lockstep the ring takes n full-block steps
+and ~half the block matmuls are thrown away (VERDICT weak #4).
+
+The zigzag layout fixes the load imbalance structurally. Split the
+global sequence into 2n chunks; device i holds the PAIR
+``(chunk i, chunk 2n−1−i)`` — one early, one late. At ring step s the
+K/V pair from device j = (i−s) mod n arrives, and causality decides
+per sub-block:
+
+  q-early(i)  × k-early(j): needed iff s ≤ i       (diagonal at s=0)
+  q-early(i)  × k-late(j):  never (always future)
+  q-late(i)   × k-early(j): always, fully visible
+  q-late(i)   × k-late(j):  needed iff s = 0 or s > i  (diag at s=0)
+
+Every device computes exactly 2 sub-blocks per step (±diagonals) —
+2n·(T/2n)² block-matmuls total versus the plain ring's 4n·(T/2n)², the
+2× causal saving, with no device idling. Skipping is real control flow
+(``lax.cond``), not masking, so the MXU never sees the dead blocks.
+
+Layout contract: callers put the whole sequence axis in zigzag order
+(``zigzag_permutation``) and run the model with explicit positions
+(``zigzag_positions``) so RoPE stays correct; attention then needs no
+position tensors at all — causality is implied by chunk ids. Packed
+segments are not supported here (use the position-aware plain ring);
+long-context runs — this schedule's reason to exist — train on full
+documents.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -2.0**30
+
+
+# ---------------------------------------------------------------------
+# layout helpers
+# ---------------------------------------------------------------------
+
+def zigzag_permutation(T: int, n: int) -> np.ndarray:
+    """Natural → zigzag gather indices: result[t] = natural index held
+    at zigzag position t. Device i's shard is chunks (i, 2n−1−i)."""
+    assert T % (2 * n) == 0, f"T={T} must split into 2n={2 * n} chunks"
+    c = T // (2 * n)
+    order = []
+    for i in range(n):
+        order.extend(range(i * c, (i + 1) * c))
+        order.extend(range((2 * n - 1 - i) * c, (2 * n - i) * c))
+    return np.asarray(order, dtype=np.int32)
+
+
+def inverse_permutation(perm: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size, dtype=perm.dtype)
+    return inv
+
+
+def zigzag_positions(T: int, n: int) -> np.ndarray:
+    """Global positions of a zigzag-ordered sequence (feed to RoPE)."""
+    return zigzag_permutation(T, n)
+
+
+# ---------------------------------------------------------------------
+# the local collective kernel (call inside shard_map)
+# ---------------------------------------------------------------------
+
+def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp",
+                          causal: bool = True):
+    """Local shard attention; shards are zigzag pairs (early‖late).
+
+    q: (B, Tloc, H, D), k/v: (B, Tloc, KVH, D) with Tloc = 2·chunk.
+    Returns (B, Tloc, H, D) — the exact attention output for this
+    shard's tokens over the full global sequence.
+    """
+    n = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    B, Tloc, H, D = q.shape
+    KVH = k.shape[2]
+    assert H % KVH == 0
+    G = H // KVH
+    Tc = Tloc // 2
+    scale = D ** -0.5
+
+    if not causal:
+        # no masked blocks to skip — defer to the plain ring
+        from kubeflow_rm_tpu.parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, axis_name=axis_name, causal=False)
+
+    qf = (q.astype(jnp.float32) * scale).reshape(B, 2, Tc, KVH, G, D)
+    kc0 = k.reshape(B, 2, Tc, KVH, D)
+    vc0 = v.reshape(B, 2, Tc, KVH, D)
+
+    local_tri = jnp.tril(jnp.ones((Tc, Tc), bool))  # diagonal-chunk mask
+
+    def block(qc, kc, vc, o, m, l, *, diag: bool):
+        """Fold one (Tc × Tc) K/V block into a q-chunk's accumulators."""
+        s = jnp.einsum("bqkgd,bskd->bkgqs", qc, kc.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        if diag:
+            s = jnp.where(local_tri[None, None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if diag:
+            p = jnp.where(local_tri[None, None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bkgqs,bskd->bkgqd", p, vc.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        return o * corr[..., None] + pv, m_new, l_new
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, s):
+        (oe, me, le), (ol, ml, ll), kc, vc = carry
+        ke, kl = kc[:, 0], kc[:, 1]
+        ve, vl = vc[:, 0], vc[:, 1]
+        qe, ql = qf[:, 0], qf[:, 1]
+
+        # q-early × k-early: s == 0 is the diagonal; s ≤ i full
+        oe, me, le = jax.lax.cond(
+            s == 0,
+            lambda a: block(qe, ke, ve, *a, diag=True),
+            lambda a: jax.lax.cond(
+                s <= i,
+                lambda b: block(qe, ke, ve, *b, diag=False),
+                lambda b: b, a),
+            (oe, me, le))
+        # q-late × k-early: always fully visible
+        ol, ml, ll = block(ql, ke, ve, ol, ml, ll, diag=False)
+        # q-late × k-late: diagonal at s == 0, full when s > i
+        ol, ml, ll = jax.lax.cond(
+            s == 0,
+            lambda a: block(ql, kl, vl, *a, diag=True),
+            lambda a: jax.lax.cond(
+                s > i,
+                lambda b: block(ql, kl, vl, *b, diag=False),
+                lambda b: b, a),
+            (ol, ml, ll))
+        # q-early × k-late is future for every (i, j): never computed
+
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return ((oe, me, le), (ol, ml, ll), kc, vc), None
+
+    def varying(x):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+    def zeros():
+        return (varying(jnp.zeros((B, KVH, G, Tc, D), jnp.float32)),
+                varying(jnp.full((B, KVH, G, Tc), NEG_INF, jnp.float32)),
+                varying(jnp.zeros((B, KVH, G, Tc), jnp.float32)))
+
+    init = (zeros(), zeros(), kc0, vc0)
+    ((oe, me, le), (ol, ml, ll), _, _), _ = jax.lax.scan(
+        jax.checkpoint(step), init, jnp.arange(n))
+
+    def finish(o, l):
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return out.transpose(0, 3, 1, 2, 4).reshape(B, Tc, H, D)
+
+    out = jnp.concatenate([finish(oe, le), finish(ol, ll)], axis=1)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------
+# global-view wrapper
+# ---------------------------------------------------------------------
+
+def zigzag_ring_self_attention(q, k, v, mesh: Mesh, *,
+                               causal: bool = True,
+                               inputs_zigzag: bool = False):
+    """shard_map wrapper over the ``sp`` axis.
+
+    With ``inputs_zigzag=False`` the inputs are natural-order global
+    arrays: they are permuted into zigzag layout, attended, and
+    permuted back (two sharded gathers — fine for tests and one-off
+    calls; put the whole model in zigzag order for training, see
+    module docstring). With ``inputs_zigzag=True`` the caller already
+    owns the layout and no permutation happens.
+    """
+    n = mesh.shape["sp"]
+    T = q.shape[1]
+    spec = P(None, "sp", None, None)
+
+    fn = jax.shard_map(
+        partial(zigzag_ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={"sp"},
+    )
+    if inputs_zigzag:
+        return fn(q, k, v)
+
+    perm = jnp.asarray(zigzag_permutation(T, n))
+    inv = jnp.asarray(inverse_permutation(np.asarray(perm)))
+    out = fn(q[:, perm], k[:, perm], v[:, perm])
+    return out[:, inv]

@@ -1,0 +1,57 @@
+"""Profiling hooks — a capability the reference lacks entirely
+(SURVEY.md §5: "Tracing / profiling: none").
+
+Two layers:
+- **In-image (device)**: ``trace()`` wraps the JAX profiler so a
+  notebook user captures an XLA trace of a training interval and views
+  it in xprof/tensorboard; ``annotate()`` names host-side regions in
+  that trace.
+- **Control plane (host)**: the web apps already expose Prometheus
+  metrics; ``profile_wsgi`` adds on-demand cProfile capture around a
+  WSGI app for the pprof-style "why is this request slow" question.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import cProfile
+import io
+import pstats
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, create_perfetto_link: bool = False):
+    """Capture a JAX/XLA device trace for the enclosed region:
+
+        with profiling.trace("/home/jovyan/traces"):
+            state, metrics = step(state, batch)
+
+    View with tensorboard (profile plugin) pointed at ``logdir``.
+    """
+    import jax
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named host region inside a device trace (TraceAnnotation)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def profile_wsgi(sort: str = "cumulative", limit: int = 30):
+    """cProfile a block of WSGI handling; yields a StringIO that holds
+    the stats table after exit."""
+    out = io.StringIO()
+    prof = cProfile.Profile()
+    prof.enable()
+    try:
+        yield out
+    finally:
+        prof.disable()
+        pstats.Stats(prof, stream=out).sort_stats(sort).print_stats(limit)

@@ -93,3 +93,13 @@ def test_get_all_namespaces_requires_cluster_admin(stack):
     crb["subjects"] = [{"kind": "User", "name": USER}]
     api.create(crb)
     assert client.get("/api/workgroup/get-all-namespaces").status_code == 200
+
+
+def test_metrics_endpoint_serves_prometheus_exposition(stack):
+    api, _ = stack
+    app = dashboard.create_app(api)
+    resp = app.test_client(user=None)._client.get("/metrics")
+    assert resp.status_code == 200
+    body = resp.get_data(as_text=True)
+    assert "notebook_running" in body
+    assert "tpu_chips_requested" in body

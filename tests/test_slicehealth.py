@@ -1,0 +1,94 @@
+"""Slice health: whole-slice restart semantics (SURVEY.md §5 — the
+failure-detection capability the reference lacks; a slice recovers
+whole or not at all)."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import make_tpu_node
+
+
+@pytest.fixture
+def stack():
+    api, mgr = make_control_plane()
+    api.ensure_namespace("ns")
+    return api, mgr
+
+
+def ready_slice(api, mgr, name="nb", accel="v5p-16", nodes=2):
+    for i in range(nodes):
+        api.create(make_tpu_node(f"{name}-n{i}", accel))
+    api.create(make_notebook(name, "ns", accelerator_type=accel))
+    mgr.run_until_idle()
+    assert api.get(nb_api.KIND, name, "ns")["status"]["readyReplicas"] \
+        == nodes
+    return api.list("Pod", "ns")
+
+
+def test_failed_worker_restarts_whole_slice(stack):
+    api, mgr = stack
+    pods = ready_slice(api, mgr)
+    uids_before = {p["metadata"]["uid"] for p in pods}
+
+    # preemption kills worker 1
+    victim = api.get("Pod", "nb-1", "ns")
+    victim["status"] = {"phase": "Failed"}
+    api.update_status(victim)
+    mgr.run_until_idle()
+
+    nb = api.get(nb_api.KIND, "nb", "ns")
+    evs = api.events_for(nb)
+    assert any(e["reason"] == "SliceRestart" for e in evs), evs
+    # the whole slice came back: both pods fresh and Running
+    pods_after = api.list("Pod", "ns")
+    assert len(pods_after) == 2
+    assert {p["metadata"]["uid"] for p in pods_after}.isdisjoint(
+        uids_before)
+    assert all(deep_get(p, "status", "phase") == "Running"
+               for p in pods_after)
+
+
+def test_vanished_worker_restarts_whole_slice(stack):
+    api, mgr = stack
+    ready_slice(api, mgr)
+    api.delete("Pod", "nb-1", "ns")  # node drain took the pod with it
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "nb", "ns")
+    assert any(e["reason"] == "SliceRestart"
+               for e in api.events_for(nb))
+    pods = api.list("Pod", "ns")
+    assert len(pods) == 2
+    assert all(deep_get(p, "status", "phase") == "Running" for p in pods)
+
+
+def test_single_host_recycles_only_failed_pod(stack):
+    api, mgr = stack
+    api.create(make_tpu_node("n0", "v5p-8"))
+    api.create(make_notebook("solo", "ns", accelerator_type="v5p-8"))
+    mgr.run_until_idle()
+    pod = api.get("Pod", "solo-0", "ns")
+    pod["status"] = {"phase": "Failed"}
+    api.update_status(pod)
+    mgr.run_until_idle()
+    pod = api.get("Pod", "solo-0", "ns")
+    assert deep_get(pod, "status", "phase") == "Running"
+    # no slice-restart drama for a single host
+    nb = api.get(nb_api.KIND, "solo", "ns")
+    assert not any(e["reason"] == "SliceRestart"
+                   for e in api.events_for(nb))
+
+
+def test_stopped_notebook_is_not_restarted(stack):
+    api, mgr = stack
+    ready_slice(api, mgr)
+    nb = api.get(nb_api.KIND, "nb", "ns")
+    nb["metadata"]["annotations"][nb_api.STOP_ANNOTATION] = "stopped"
+    api.update(nb)
+    mgr.run_until_idle()
+    assert api.list("Pod", "ns") == []  # drained, and it STAYS drained
+    nb = api.get(nb_api.KIND, "nb", "ns")
+    assert not any(e["reason"] == "SliceRestart"
+                   for e in api.events_for(nb))

@@ -47,11 +47,15 @@ TPU_INJECT_EXCLUDE_ANNOTATION = "notebooks.kubeflow.org/tpu-inject-exclude"
 NOTEBOOK_NAME_LABEL = "notebook-name"
 # pod label carrying the slice's accelerator type (webhook + web apps read it)
 TPU_ACCELERATOR_LABEL = "notebooks.kubeflow.org/tpu-accelerator-type"
+# pod label carrying the multislice width (>1 ⇒ DCN job; webhook
+# injects MEGASCALE_* rendezvous from it)
+TPU_NUM_SLICES_LABEL = "notebooks.kubeflow.org/tpu-num-slices"
 
 
 def make_notebook(name: str, namespace: str, *,
                   image: str = "jupyter-jax:latest",
                   accelerator_type: str | None = None,
+                  num_slices: int = 1,
                   labels: dict | None = None,
                   annotations: dict | None = None,
                   pod_spec_extra: dict | None = None,
@@ -71,6 +75,8 @@ def make_notebook(name: str, namespace: str, *,
     spec: dict = {"template": {"spec": pod_spec}}
     if accelerator_type is not None:
         spec["tpu"] = {"acceleratorType": accelerator_type}
+        if num_slices != 1:
+            spec["tpu"]["numSlices"] = num_slices
     return make_object(API_VERSION, KIND, name, namespace,
                        labels=labels, annotations=annotations, spec=spec)
 
@@ -81,6 +87,20 @@ def tpu_spec(notebook: dict) -> tpu_api.SliceTopology | None:
     if not t:
         return None
     return tpu_api.lookup(t["acceleratorType"])
+
+
+def num_slices(notebook: dict) -> int:
+    """Multislice width (1 = a single ICI-connected slice; >1 = a DCN
+    job of identical slices, rendered as one gang-scheduled pool)."""
+    return int(deep_get(notebook, "spec", "tpu", "numSlices", default=1))
+
+
+def total_hosts(notebook: dict) -> int:
+    """Pods the notebook renders to: hosts-per-slice × numSlices."""
+    topo = tpu_spec(notebook)
+    if topo is None:
+        return 1
+    return topo.hosts * num_slices(notebook)
 
 
 def validate(notebook: dict) -> None:
@@ -94,3 +114,6 @@ def validate(notebook: dict) -> None:
         if "acceleratorType" not in t:
             raise ValueError("spec.tpu requires acceleratorType")
         tpu_api.lookup(t["acceleratorType"])  # raises on unknown
+        ns = t.get("numSlices", 1)
+        if not isinstance(ns, int) or ns < 1:
+            raise ValueError("spec.tpu.numSlices must be an int >= 1")

@@ -91,7 +91,10 @@ class NotebookController(Controller):
                               topo: tpu_api.SliceTopology | None) -> dict:
         name = name_of(notebook)
         ns = notebook["metadata"]["namespace"]
-        hosts = topo.hosts if topo else 1
+        # multislice: one StatefulSet spans every slice (slice_id =
+        # ordinal // hosts-per-slice); the webhook derives per-slice
+        # rendezvous + MEGASCALE_* DCN env from the labels below
+        hosts = nb_api.total_hosts(notebook)
         stopped = nb_api.STOP_ANNOTATION in annotations_of(notebook)
         replicas = 0 if stopped else hosts
 
@@ -109,6 +112,9 @@ class NotebookController(Controller):
         pod_annotations = {}
         if topo:
             pod_labels[nb_api.TPU_ACCELERATOR_LABEL] = topo.accelerator_type
+            nslices = nb_api.num_slices(notebook)
+            if nslices > 1:
+                pod_labels[nb_api.TPU_NUM_SLICES_LABEL] = str(nslices)
             if containers:
                 limits = containers[0].setdefault("resources", {}) \
                     .setdefault("limits", {})
@@ -181,7 +187,7 @@ class NotebookController(Controller):
     def _mirror_status(self, api: APIServer, notebook: dict,
                        topo: tpu_api.SliceTopology | None) -> None:
         name, ns = name_of(notebook), notebook["metadata"]["namespace"]
-        hosts = topo.hosts if topo else 1
+        hosts = nb_api.total_hosts(notebook)
         sts = api.try_get("StatefulSet", name, ns)
         ready = deep_get(sts, "status", "readyReplicas", default=0) if sts \
             else 0

@@ -52,8 +52,8 @@ class SliceHealthController(Controller):
                 nb["metadata"].get("annotations") or {}):
             return None  # stopped/culled: drained pods are expected
 
-        topo = nb_api.tpu_spec(nb)
-        hosts = topo.hosts if topo else 1
+        # a multislice job is ONE gang: any slice's failure restarts all
+        hosts = nb_api.total_hosts(nb)
         pods = [
             p for p in api.list("Pod", req.namespace)
             if (p["metadata"].get("labels") or {}).get(

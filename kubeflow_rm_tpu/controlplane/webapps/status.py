@@ -49,7 +49,7 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
                                      "this Notebook.")
 
     topo = nb_api.tpu_spec(notebook)
-    want = topo.hosts if topo else 1
+    want = nb_api.total_hosts(notebook)
     ready = deep_get(notebook, "status", "readyReplicas", default=0)
     if ready >= want:
         return Status(PHASE_READY, "Running.")

@@ -56,18 +56,29 @@ class TpuInjectWebhook:
         if not acc_type:
             return None
         topo = tpu_api.lookup(acc_type)
+        nslices = int(labels_of(pod).get(
+            nb_api.TPU_NUM_SLICES_LABEL, "1"))
 
+        # multislice: ordinals are laid out slice-major, so ICI
+        # rendezvous (TPU_WORKER_*) is per-slice while MEGASCALE_*
+        # carries the DCN dimension
         ordinal = _pod_ordinal(pod)
-        hostnames = self._worker_hostnames(pod, topo)
+        slice_id, worker_in_slice = divmod(ordinal, topo.hosts)
+        slice_hosts = self._worker_hostnames(pod, topo, slice_id)
 
         pod = copy.deepcopy(pod)
         spec = pod["spec"]
         for c in spec.get("containers") or []:
             env = c.setdefault("env", [])
-            _upsert(env, "TPU_WORKER_ID", str(ordinal))
-            _upsert(env, "TPU_WORKER_HOSTNAMES", ",".join(hostnames))
+            _upsert(env, "TPU_WORKER_ID", str(worker_in_slice))
+            _upsert(env, "TPU_WORKER_HOSTNAMES", ",".join(slice_hosts))
             _upsert(env, "TPU_ACCELERATOR_TYPE", topo.accelerator_type)
             _upsert(env, "TPU_TOPOLOGY", topo.topology)
+            if nslices > 1:
+                coord = self._worker_hostnames(pod, topo, 0)[0]
+                _upsert(env, "MEGASCALE_NUM_SLICES", str(nslices))
+                _upsert(env, "MEGASCALE_SLICE_ID", str(slice_id))
+                _upsert(env, "MEGASCALE_COORDINATOR_ADDRESS", coord)
             mounts = c.setdefault("volumeMounts", [])
             if not any(m.get("mountPath") == "/dev/shm" for m in mounts):
                 mounts.append(dict(SHM_MOUNT))
@@ -76,17 +87,18 @@ class TpuInjectWebhook:
             vols.append(copy.deepcopy(SHM_VOLUME))
         return pod
 
-    def _worker_hostnames(self, pod: dict,
-                          topo: tpu_api.SliceTopology) -> list[str]:
+    def _worker_hostnames(self, pod: dict, topo: tpu_api.SliceTopology,
+                          slice_id: int = 0) -> list[str]:
         subdomain = deep_get(pod, "spec", "subdomain")
         ns = namespace_of(pod)
         base = _base_name(pod)
         if not subdomain:
             # single-host fallback: the pod's own DNS
             return [f"{name_of(pod)}.{ns}.svc.{self.cluster_domain}"]
+        start = slice_id * topo.hosts
         return [
             f"{base}-{i}.{subdomain}.{ns}.svc.{self.cluster_domain}"
-            for i in range(topo.hosts)
+            for i in range(start, start + topo.hosts)
         ]
 
 

@@ -1,13 +1,22 @@
-"""Multi-host bootstrap from platform-injected env.
+"""Multi-host and multi-slice bootstrap from platform-injected env.
 
-This is the in-image consumer of the control plane's rendezvous contract:
-the webhook in ``controlplane/webhook/tpu_inject.py`` injects
-``TPU_WORKER_ID`` (pod ordinal) and ``TPU_WORKER_HOSTNAMES``
-(headless-service DNS of every pod in the slice) into each pod of a
-multi-host Notebook, and ``tests/test_notebook_controller.py`` asserts
-the round-trip through this module. The reference has no equivalent —
-its servers are single-pod (SURVEY.md §2.6, notebook_controller.go:409-412
-replicas in {0,1}) — so this module plus the webhook is new capability.
+This is the in-image consumer of the control plane's rendezvous
+contract. The webhook (``controlplane/webhook/tpu_inject.py``) injects
+into every pod of a TPU notebook:
+
+- ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` — the pod's ordinal
+  WITHIN ITS SLICE and the headless-service DNS of its slice peers
+  (ICI rendezvous);
+- ``MEGASCALE_NUM_SLICES`` / ``MEGASCALE_SLICE_ID`` /
+  ``MEGASCALE_COORDINATOR_ADDRESS`` — present only on multislice
+  notebooks (``spec.tpu.numSlices > 1``), carrying the DCN dimension.
+
+``initialize`` turns that env into one global ``jax.distributed``
+job: process_id = slice_id·hosts_per_slice + worker_id, coordinator =
+slice 0's worker 0. libtpu reads the TPU_* vars itself for ICI; jax's
+megascale transport reads MEGASCALE_* for DCN. The reference platform
+has no counterpart — its servers are single-pod (SURVEY.md §2.6,
+``notebook_controller.go:409-412``).
 """
 
 import os
@@ -20,18 +29,35 @@ DEFAULT_COORDINATOR_PORT = 8476
 
 @dataclass(frozen=True)
 class TpuEnv:
-    worker_id: int
-    worker_hostnames: list[str]
+    worker_id: int               # ordinal within the slice
+    worker_hostnames: list[str]  # this slice's peers
     accelerator_type: str | None
     topology: str | None
+    num_slices: int = 1
+    slice_id: int = 0
+    coordinator: str | None = None  # multislice: slice 0's worker 0
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return max(1, len(self.worker_hostnames))
 
     @property
     def num_hosts(self) -> int:
-        return max(1, len(self.worker_hostnames))
+        """Global process count across every slice."""
+        return self.hosts_per_slice * max(1, self.num_slices)
+
+    @property
+    def process_id(self) -> int:
+        """Global jax process id (slice-major, matching pod ordinals)."""
+        return self.slice_id * self.hosts_per_slice + self.worker_id
 
     @property
     def is_multihost(self) -> bool:
         return self.num_hosts > 1
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
 
 
 def tpu_env(environ=None) -> TpuEnv:
@@ -45,19 +71,28 @@ def tpu_env(environ=None) -> TpuEnv:
         worker_hostnames=hostnames,
         accelerator_type=env.get("TPU_ACCELERATOR_TYPE"),
         topology=env.get("TPU_TOPOLOGY"),
+        num_slices=int(env.get("MEGASCALE_NUM_SLICES", "1")),
+        slice_id=int(env.get("MEGASCALE_SLICE_ID", "0")),
+        coordinator=env.get("MEGASCALE_COORDINATOR_ADDRESS"),
     )
 
 
 def initialize(environ=None, port: int = DEFAULT_COORDINATOR_PORT) -> TpuEnv:
     """Initialize ``jax.distributed`` from the injected env (no-op on
-    single-host slices). Worker 0's headless DNS name is the coordinator —
-    pod ordinals are stable because the controller renders the slice as a
-    StatefulSet with a headless service."""
+    single-host single-slice). The coordinator is slice 0's worker 0 —
+    pod ordinals are stable because the controller renders the job as a
+    StatefulSet with a headless service, and multislice ordinals are
+    slice-major so every process derives the same global numbering."""
     env = tpu_env(environ)
-    if env.is_multihost:
-        jax.distributed.initialize(
-            coordinator_address=f"{env.worker_hostnames[0]}:{port}",
-            num_processes=env.num_hosts,
-            process_id=env.worker_id,
-        )
+    if not env.is_multihost:
+        return env
+    if env.is_multislice and env.coordinator:
+        coordinator = env.coordinator
+    else:
+        coordinator = env.worker_hostnames[0]
+    jax.distributed.initialize(
+        coordinator_address=f"{coordinator}:{port}",
+        num_processes=env.num_hosts,
+        process_id=env.process_id,
+    )
     return env

@@ -59,6 +59,53 @@ class MeshConfig:
         return tuple(sizes)
 
 
+def make_hybrid_mesh(config: MeshConfig | None = None, *,
+                     n_slices: int, devices=None) -> Mesh:
+    """Multislice mesh: ``dp`` spans slices over DCN; fsdp/sp/tp stay
+    inside each slice on ICI (the scaling-book layout — parameters are
+    gathered over fast links, only gradients cross the data-center
+    network). ``config.dp`` must equal ``n_slices`` (or -1).
+
+    Uses ``mesh_utils.create_hybrid_device_mesh`` so device order
+    respects slice locality; under multislice the platform guarantees
+    slice-major process ids (``distributed.initialize``), which is what
+    makes the per-slice device blocks contiguous here.
+    """
+    from jax.experimental import mesh_utils
+
+    config = config or MeshConfig()
+    devices = devices if devices is not None else jax.devices()
+    if config.dp == -1:
+        config = MeshConfig(dp=n_slices, fsdp=config.fsdp, sp=config.sp,
+                            tp=config.tp)
+    shape = config.resolve(len(devices))
+    if shape[0] != n_slices:
+        raise ValueError(
+            f"dp axis ({shape[0]}) must equal n_slices ({n_slices}) — "
+            "dp is the DCN axis in a multislice job")
+    per_slice = len(devices) // n_slices
+    dev_mesh = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(1, *shape[1:]),
+        dcn_mesh_shape=(n_slices, 1, 1, 1),
+        devices=devices,
+        process_is_granule=False,
+        should_sort_granules_by_key=True,
+    ) if _has_slice_index(devices) else _reshape_fallback(devices, shape)
+    return Mesh(dev_mesh.reshape(shape), AXES,
+                axis_types=(AxisType.Auto,) * len(AXES))
+
+
+def _has_slice_index(devices) -> bool:
+    return getattr(devices[0], "slice_index", None) is not None
+
+
+def _reshape_fallback(devices, shape):
+    """CPU-mesh tests have no slice_index: slice-major order is just
+    the device list order (the dryrun contract)."""
+    import numpy as np
+    return np.asarray(devices).reshape(shape)
+
+
 def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     """Build the framework-standard 4-axis mesh over ``devices``."""
     config = config or MeshConfig()

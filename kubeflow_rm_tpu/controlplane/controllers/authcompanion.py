@@ -224,11 +224,43 @@ class AuthCompanionController(Controller):
     # ---- helper ------------------------------------------------------
     @staticmethod
     def _ensure(api: APIServer, owner: dict, obj: dict) -> None:
+        """Create-or-repair a companion object.
+
+        Diffs every field the companion controller owns — not just
+        ``spec``: the ServiceAccount's oauth-redirectreference and the
+        Service's serving-cert annotations live in metadata, and the
+        RoleBinding's reconciled state is ``roleRef``/``subjects``;
+        objects mutated there (or created without them) must be
+        repaired too (ADVICE r2).
+        """
         existing = api.try_get(obj["kind"], obj["metadata"]["name"],
                                obj["metadata"].get("namespace"))
         set_controller_reference(owner, obj)
         if existing is None:
             api.create(obj)
-        elif existing.get("spec") != obj.get("spec"):
-            existing["spec"] = obj.get("spec")
+            return
+        changed = False
+        # adopt pre-existing objects: without the ownerReference, GC
+        # would skip them on Notebook deletion and leak the companion
+        # (a stale RoleBinding = a lingering access grant)
+        want_refs = obj["metadata"].get("ownerReferences") or []
+        if want_refs and not (
+                existing["metadata"].get("ownerReferences") or []):
+            existing["metadata"]["ownerReferences"] = want_refs
+            changed = True
+        want_ann = obj["metadata"].get("annotations") or {}
+        have_ann = existing["metadata"].get("annotations") or {}
+        # only repair annotations this controller set; foreign
+        # annotations (kubectl applied-config, etc.) are left alone
+        for k, v in want_ann.items():
+            if have_ann.get(k) != v:
+                existing["metadata"].setdefault(
+                    "annotations", {})[k] = v
+                changed = True
+        for top in ("spec", "roleRef", "subjects", "rules", "data",
+                    "stringData", "type"):
+            if top in obj and existing.get(top) != obj[top]:
+                existing[top] = obj[top]
+                changed = True
+        if changed:
             api.update(existing)

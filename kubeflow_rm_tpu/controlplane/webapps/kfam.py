@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import re
 
-from werkzeug.exceptions import BadRequest
+from werkzeug.exceptions import BadRequest, Forbidden
 
 from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
 from kubeflow_rm_tpu.controlplane.api.profile import make_profile
@@ -52,9 +52,22 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         user_filter = req.args.get("user")
         role_filter = req.args.get("role")
         out = []
-        namespaces = ([ns_filter] if ns_filter else
-                      [n["metadata"]["name"]
-                       for n in api.list("Namespace")])
+        if ns_filter:
+            # explicit namespace: hard 403 if the caller may not read
+            # its role grants (ADVICE r2: was world-readable)
+            app.ensure_authorized(req, "list", "rolebindings", ns_filter)
+            namespaces = [ns_filter]
+        else:
+            # cluster-wide listing: silently scope to namespaces the
+            # caller may read, mirroring the reference's per-namespace
+            # SubjectAccessReview filtering
+            caller = app.username(req)
+            namespaces = [
+                n["metadata"]["name"] for n in api.list("Namespace")
+                if app.disable_auth or api.access_review(
+                    caller, "list", "rolebindings",
+                    n["metadata"]["name"])
+            ]
         for ns in namespaces:
             for rb in api.list("RoleBinding", ns):
                 ann = rb["metadata"].get("annotations") or {}
@@ -115,7 +128,20 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
 
     @app.route("/kfam/v1/profiles")
     def get_profiles(req):
-        return {"profiles": api.list("Profile")}
+        profiles = api.list("Profile")
+        if app.disable_auth:
+            return {"profiles": profiles}
+        caller = app.username(req)
+        if api.access_review(caller, "list", "profiles"):
+            return {"profiles": profiles}  # cluster admin sees all
+        # everyone else: own profiles + namespaces they contribute to
+        visible = []
+        for p in profiles:
+            name = p["metadata"]["name"]
+            if deep_get(p, "spec", "owner", "name") == caller or \
+                    api.access_review(caller, "get", "profiles", name):
+                visible.append(p)
+        return {"profiles": visible}
 
     @app.route("/kfam/v1/profiles", methods=("POST",))
     def post_profile(req):
@@ -125,6 +151,15 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         if not name or not owner:
             raise BadRequest("profile requires metadata.name and "
                              "spec.owner.name")
+        # self-registration (the dashboard workgroup flow) is always
+        # allowed; creating a profile for SOMEONE ELSE requires real
+        # create-profiles RBAC (ADVICE r2: was unauthenticated)
+        caller = app.username(req)
+        if not app.disable_auth and owner != caller and \
+                not api.access_review(caller, "create", "profiles"):
+            raise Forbidden(
+                f"User '{caller}' may not create a profile owned by "
+                f"'{owner}'")
         api.create(make_profile(name, owner))
         return {"message": "Profile created successfully."}
 
@@ -135,7 +170,6 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         owner = deep_get(profile, "spec", "owner", "name")
         if not app.disable_auth and user not in (owner,) and \
                 not api.access_review(user, "delete", "profiles"):
-            from werkzeug.exceptions import Forbidden
             raise Forbidden(f"User '{user}' may not delete profile "
                             f"'{name}' owned by '{owner}'")
         api.delete("Profile", name)

@@ -42,7 +42,14 @@ class WorkerAgent:
 
     @property
     def is_worker_zero(self) -> bool:
-        return self.env.worker_id == 0
+        """True only for the GLOBAL process 0 (slice 0, worker 0).
+
+        On a multislice notebook every slice has a local worker 0, but
+        JupyterLab must run exactly once in the whole job — gating on
+        the per-slice ``TPU_WORKER_ID`` alone would start a second Lab
+        on each slice and strand the global rendezvous.
+        """
+        return self.env.process_id == 0
 
     def start_health_server(self) -> int:
         """Serve /healthz; returns the bound port (ephemeral if 0)."""
@@ -100,11 +107,14 @@ class WorkerAgent:
                 # but escalate to WARNING once it stops looking like a
                 # normal kernel-start delay so a wedged slice is loud
                 level = logging.INFO if attempt <= 8 else logging.WARNING
+                coordinator = (self.env.coordinator
+                               or self.env.worker_hostnames[:1])
                 log.log(
                     level,
-                    "worker %d: coordinator %s not up yet (attempt %d: "
-                    "%s); retrying in %.0fs", self.env.worker_id,
-                    self.env.worker_hostnames[:1], attempt, e,
+                    "process %d (slice %d worker %d): coordinator %s "
+                    "not up yet (attempt %d: %s); retrying in %.0fs",
+                    self.env.process_id, self.env.slice_id,
+                    self.env.worker_id, coordinator, attempt, e,
                     retry_interval_s)
                 import time
                 time.sleep(retry_interval_s)
@@ -123,12 +133,24 @@ class WorkerAgent:
 
 
 def dict_env(env) -> dict:
+    """Round-trip a ``TpuEnv`` back to the webhook's env contract.
+
+    Must carry the MEGASCALE_* multislice vars: dropping them would
+    make ``initialize`` compute a slice-local world (num_processes =
+    hosts_per_slice, coordinator = this slice's worker 0) and the
+    global job could never assemble.
+    """
     return {
         "TPU_WORKER_ID": str(env.worker_id),
         "TPU_WORKER_HOSTNAMES": ",".join(env.worker_hostnames),
         **({"TPU_ACCELERATOR_TYPE": env.accelerator_type}
            if env.accelerator_type else {}),
         **({"TPU_TOPOLOGY": env.topology} if env.topology else {}),
+        **({"MEGASCALE_NUM_SLICES": str(env.num_slices),
+            "MEGASCALE_SLICE_ID": str(env.slice_id)}
+           if env.num_slices > 1 else {}),
+        **({"MEGASCALE_COORDINATOR_ADDRESS": env.coordinator}
+           if env.coordinator else {}),
     }
 
 

@@ -216,6 +216,8 @@ def forward(
     cfg: LlamaConfig,
     positions: jax.Array | None = None,
     segments: jax.Array | None = None,
+    *,
+    packed: bool = False,
 ) -> jax.Array:
     """Causal LM forward pass.
 
@@ -228,19 +230,25 @@ def forward(
       segments: (B, T) document segment ids for packed sequences (from
         ``training.data.pack_documents``); restricts attention to equal
         segments so packed documents stay independent.
+      packed: assert that ``positions`` restart per document and are
+        monotone within each segment (the ``pack_documents`` layout).
+        Only then may the attention mask drop positions — local-causal
+        ∧ same-segment is exact for that layout, and leaving
+        attn_positions=None keeps the call on the pallas flash kernel.
+        Without the flag, explicit positions + segments (e.g. a zigzag
+        sequence-parallel shard of packed data, whose positions are
+        NON-monotonic) keep the position-aware XLA path — silently
+        assuming monotonicity would compute a wrong mask.
 
     Returns:
       (B, T, vocab) fp32 logits.
     """
     B, T = tokens.shape
     cdt = cfg.dtype
-    # attention only needs explicit positions when the caller supplies
-    # non-contiguous ones (sequence-parallel shards); the default arange
-    # is exactly local-index causality, and leaving attn_positions=None
-    # keeps the call eligible for the pallas flash kernel. Packed
-    # sequences pass positions for RoPE but their mask is fully captured
-    # by local-causal ∧ segments (see ops/flash_attention.py).
-    attn_positions = None if segments is not None else positions
+    if positions is None or packed:
+        attn_positions = None
+    else:
+        attn_positions = positions
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 

@@ -71,19 +71,46 @@ def fit(
 
     ``data`` yields host batches of ``{"tokens", "labels", ...}``;
     ``batch_keys`` defaults to the first batch's keys.
+
+    On resume the iterator is fast-forwarded past the batches the
+    restored steps already consumed, so a deterministic ``data`` stream
+    replays exactly the sequence an uninterrupted run would have seen
+    (non-deterministic streams get fresh batches — no worse than the
+    reference's stop/start semantics).
     """
+    if loop.log_every < 1:
+        raise ValueError(f"log_every must be >= 1, got {loop.log_every}")
     ckpt = (Checkpointer(loop.checkpoint_dir, max_to_keep=loop.max_to_keep)
             if loop.checkpoint_dir else None)
 
+    resumed = False
     if state is None:
         state = ckpt.restore(cfg, mesh) if ckpt else None
         if state is not None:
+            resumed = True
             log.info("resumed from step %d", int(state.step))
         else:
             state = init_train_state(cfg, jax.random.key(loop.seed))
 
     data = iter(data)
-    first = next(data)
+    if resumed:
+        skip = min(int(jax.device_get(state.step)), loop.total_steps)
+        for _ in range(skip):
+            try:
+                next(data)
+            except StopIteration:
+                break
+    try:
+        first = next(data)
+    except StopIteration:
+        # stream exhausted by the fast-forward (e.g. fit() re-invoked
+        # after a completed run on an epoch-sized stream): nothing left
+        # to train on — return the restored state instead of crashing
+        log.warning("data exhausted before step %d; nothing to do",
+                    int(jax.device_get(state.step)))
+        if ckpt:
+            ckpt.close()
+        return state, []
     if batch_keys is None:
         batch_keys = tuple(first.keys())
     step_fn = make_train_step(cfg, mesh, state, batch_keys=batch_keys)

@@ -77,9 +77,12 @@ def state_shardings(cfg: TrainConfig, state: TrainState, mesh: Mesh) -> TrainSta
 
 
 def loss_fn(params, batch, cfg: TrainConfig):
+    # batches come from training.data (pack_documents layout: per-doc
+    # restarting positions), so the packed fast path is sound here
     logits = forward(params, batch["tokens"], cfg.model,
                      positions=batch.get("positions"),
-                     segments=batch.get("segments"))
+                     segments=batch.get("segments"),
+                     packed=batch.get("segments") is not None)
     return softmax_cross_entropy(logits, batch["labels"], z_loss=cfg.z_loss)
 
 

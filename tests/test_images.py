@@ -138,6 +138,66 @@ def test_worker_agent_retries_until_coordinator():
         dist.initialize = orig
 
 
+def test_multislice_agent_roundtrips_megascale_env():
+    """ADVICE r2 (high): a slice>=1 agent must (a) NOT consider itself
+    worker zero even with local TPU_WORKER_ID=0, and (b) pass the
+    MEGASCALE_* vars through to initialize so the GLOBAL world
+    (hosts x slices processes, slice-0 coordinator) assembles."""
+    from kubeflow_rm_tpu.launcher.agent import WorkerAgent, dict_env
+
+    slice1_local0 = WorkerAgent({
+        "TPU_WORKER_ID": "0",
+        "TPU_WORKER_HOSTNAMES": "nb-0.s.u.svc,nb-1.s.u.svc",
+        "MEGASCALE_NUM_SLICES": "2",
+        "MEGASCALE_SLICE_ID": "1",
+        "MEGASCALE_COORDINATOR_ADDRESS": "nb-0.s.u.svc",
+    })
+    assert not slice1_local0.is_worker_zero  # global process id is 2
+    assert slice1_local0.env.process_id == 2
+
+    env = dict_env(slice1_local0.env)
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "nb-0.s.u.svc"
+
+    import jax
+
+    from kubeflow_rm_tpu.parallel.distributed import (
+        DEFAULT_COORDINATOR_PORT, initialize)
+    calls = []
+    orig = jax.distributed.initialize
+    jax.distributed.initialize = lambda **kw: calls.append(kw)
+    try:
+        initialize(env)
+    finally:
+        jax.distributed.initialize = orig
+    assert calls == [{
+        "coordinator_address": f"nb-0.s.u.svc:{DEFAULT_COORDINATOR_PORT}",
+        "num_processes": 4,
+        "process_id": 2,
+    }]
+
+    # the true global zero: slice 0, worker 0
+    global_zero = WorkerAgent({
+        "TPU_WORKER_ID": "0",
+        "TPU_WORKER_HOSTNAMES": "nb-0.s.u.svc,nb-1.s.u.svc",
+        "MEGASCALE_NUM_SLICES": "2",
+        "MEGASCALE_SLICE_ID": "0",
+    })
+    assert global_zero.is_worker_zero
+
+
+def test_s6_scripts_gate_on_global_process_id():
+    """Both s6 run scripts must include the slice id in their worker-0
+    check, or slice>=1's local worker 0 starts a second JupyterLab."""
+    lab = (IMAGES / "jupyter" / "s6/services.d/jupyterlab/run").read_text()
+    agent = (IMAGES / "jupyter-jax" /
+             "s6/services.d/worker-agent/run").read_text()
+    for script in (lab, agent):
+        assert "MEGASCALE_SLICE_ID" in script
+        assert "TPU_WORKER_ID" in script
+
+
 def test_base_image_s6_arch_follows_targetarch():
     df = (IMAGES / "base" / "Dockerfile").read_text()
     assert "S6_ARCH=x86_64" in df and "S6_ARCH=aarch64" in df

@@ -40,6 +40,32 @@ def test_forward_shapes_and_dtype():
     assert np.all(np.isfinite(np.asarray(logits)))
 
 
+def test_nonmonotonic_positions_with_segments_keep_position_mask():
+    """ADVICE r2: explicit non-monotonic positions + segments must NOT
+    fall back to a local-index causal mask. A permuted sequence carrying
+    its true positions must produce the permuted logits of the ordered
+    sequence (attention is permutation-equivariant when positions drive
+    both RoPE and the mask); packed=True opts into the local-causal
+    fast path only for the pack_documents layout."""
+    from dataclasses import replace
+    cfg = replace(LlamaConfig.tiny(), remat=False)
+    params = init_params(cfg, jax.random.key(3))
+    T = 16
+    tokens = jax.random.randint(jax.random.key(4), (1, T), 0,
+                                cfg.vocab_size)
+    ordered = forward(params, tokens, cfg)
+
+    perm = np.random.default_rng(0).permutation(T)
+    tokens_perm = tokens[:, perm]
+    positions = jnp.asarray(perm, jnp.int32)[None, :]
+    segments = jnp.ones((1, T), jnp.int32)
+    permuted = forward(params, tokens_perm, cfg, positions=positions,
+                       segments=segments)
+    np.testing.assert_allclose(np.asarray(permuted),
+                               np.asarray(ordered[:, perm]),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_forward_causality():
     cfg = LlamaConfig.tiny()
     params = init_params(cfg, jax.random.key(0))

@@ -139,6 +139,58 @@ def test_kfam_contributor_binding_grants_access(stack):
                                  "team")
 
 
+def test_kfam_bindings_listing_is_scoped_to_callers_namespaces(stack):
+    """ADVICE r2 (medium): GET /kfam/v1/bindings must not enumerate
+    every namespace's grants for any authenticated user."""
+    api, _ = stack
+    api.ensure_namespace("secret-team")
+    grant_admin(api, "secret-team", "mallory@corp.com")
+    app = kfam.create_app(api)
+
+    # alice (admin only in "team") can't read secret-team's bindings
+    client = app.test_client(user=USER)
+    resp = client.get("/kfam/v1/bindings?namespace=secret-team")
+    assert resp.status_code == 403
+    # and the cluster-wide listing silently omits secret-team
+    listing = json.loads(
+        client.get("/kfam/v1/bindings").get_data())["bindings"]
+    assert all(b["referredNamespace"] != "secret-team" for b in listing)
+
+    # an anonymous caller gets 401 everywhere
+    anon = app.test_client(user=None)
+    assert anon.get(
+        "/kfam/v1/bindings?namespace=team").status_code == 401
+
+
+def test_kfam_profile_creation_requires_self_or_rbac(stack):
+    """ADVICE r2 (medium): POST /kfam/v1/profiles with a foreign owner
+    needs create-profiles RBAC; self-registration stays open."""
+    api, _ = stack
+    app = kfam.create_app(api)
+    client = app.test_client(user=USER)
+
+    # foreign owner -> 403
+    resp = post_json(client, "/kfam/v1/profiles", {
+        "metadata": {"name": "evil"},
+        "spec": {"owner": {"kind": "User", "name": "victim@corp.com"}}})
+    assert resp.status_code == 403
+    assert api.try_get("Profile", "evil") is None
+
+    # self-registration -> 200 (dashboard workgroup flow)
+    resp = post_json(client, "/kfam/v1/profiles", {
+        "metadata": {"name": "alice-ns"},
+        "spec": {"owner": {"kind": "User", "name": USER}}})
+    assert resp.status_code == 200
+
+    # GET profiles: alice sees her own, not others'
+    api.create(__import__(
+        "kubeflow_rm_tpu.controlplane.api.profile",
+        fromlist=["make_profile"]).make_profile("bobs", "bob@corp.com"))
+    got = json.loads(client.get("/kfam/v1/profiles").get_data())
+    names = {p["metadata"]["name"] for p in got["profiles"]}
+    assert "alice-ns" in names and "bobs" not in names
+
+
 def test_kfam_profile_lifecycle_and_clusteradmin(stack):
     api, mgr = stack
     app = kfam.create_app(api)

@@ -89,3 +89,61 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     if enable_culling:
         manager.add(CullingController(**(culler_config or {})))
     return api, manager
+
+
+def make_cluster_manager(api, *, enable_culling: bool = True,
+                         culler_config=None):
+    """Controller wiring for a REAL cluster (``deploy.kubeclient``):
+    same reconcilers as ``make_control_plane`` minus the pieces a real
+    cluster provides itself — the StatefulSet/Deployment controllers
+    (kube-controller-manager + kubelet) and the admission webhooks
+    (served over HTTPS by ``deploy.webhook_server`` instead).
+
+    Equivalent of the reference's manager processes
+    (``notebook-controller/main.go:58-148`` + odh + profile + tb +
+    pvcviewer managers, collapsed into one here).
+    """
+    from kubeflow_rm_tpu.controlplane.controllers.authcompanion import (
+        AuthCompanionController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.culling import (
+        CullingController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.notebook import (
+        NotebookController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.profile import (
+        ProfileController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.pvcviewer import (
+        PVCViewerController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.slicehealth import (
+        SliceHealthController,
+    )
+    from kubeflow_rm_tpu.controlplane.controllers.tensorboard import (
+        TensorboardController,
+    )
+    from kubeflow_rm_tpu.controlplane.runtime import Manager
+    from kubeflow_rm_tpu.controlplane.webhook.notebook import (
+        LockReleaseController,
+    )
+
+    manager = Manager(api)
+    manager.add(NotebookController())
+    manager.add(LockReleaseController())
+    manager.add(AuthCompanionController())
+    manager.add(SliceHealthController())
+    manager.add(ProfileController())
+    manager.add(TensorboardController())
+    manager.add(PVCViewerController())
+    if enable_culling:
+        manager.add(CullingController(**(culler_config or {})))
+    return manager
+
+
+# kinds the cluster manager watches (one watch thread per kind)
+WATCHED_KINDS = (
+    "Notebook", "Profile", "Tensorboard", "PVCViewer",
+    "StatefulSet", "Deployment", "Service", "Pod", "Event",
+)

@@ -1,0 +1,171 @@
+"""Process entrypoints — ``python -m kubeflow_rm_tpu.controlplane <cmd>``.
+
+The reference ships one ``main.go`` per component (controller manager
+``notebook-controller/main.go:58-148``, webhook server
+``admission-webhook/main.go:755-773``, Flask ``entrypoint.py`` per web
+app, Express for the dashboard). This module is all of them behind one
+binary, the way kubebuilder projects expose subcommands:
+
+    controller-manager   watch-driven reconcile loop (kube adapter)
+    webhook-server       HTTPS AdmissionReview server
+    jupyter-web-app      spawner backend          (WSGI, werkzeug)
+    volumes-web-app      PVC + viewer backend
+    tensorboards-web-app TB CR backend
+    kfam                 access management REST
+    dashboard            central dashboard API (+ SPA)
+    crds                 print CRD YAML to stdout
+    manifests            write the kustomize tree to a directory
+
+Env (reference convention of env-var feature flags, SURVEY.md §5):
+``KUBE_API_URL``/``KUBE_TOKEN``/``KUBE_CA_CERT`` override in-cluster
+autodetection; ``ENABLE_CULLING``, ``CULL_IDLE_TIME``,
+``IDLENESS_CHECK_PERIOD`` gate the culler; ``PORT`` overrides each
+server's default port; ``WEBHOOK_TLS_CERT``/``WEBHOOK_TLS_KEY`` for the
+admission server; ``DISABLE_AUTH=true`` for dev (reference ``DEV``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+
+
+def _env_flag(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    return default if v is None else v.lower() in ("1", "true", "yes")
+
+
+def _kube_api():
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import KubeAPIServer
+    return KubeAPIServer(
+        base_url=os.environ.get("KUBE_API_URL"),
+        token=os.environ.get("KUBE_TOKEN"),
+        ca_cert=os.environ.get("KUBE_CA_CERT", True),
+    )
+
+
+def _serve_wsgi(app, default_port: int) -> None:
+    from werkzeug.serving import run_simple
+    port = int(os.environ.get("PORT", default_port))
+    run_simple("0.0.0.0", port, app, threaded=True)
+
+
+def _webapp(module: str, default_port: int) -> None:
+    import importlib
+    mod = importlib.import_module(
+        f"kubeflow_rm_tpu.controlplane.webapps.{module}")
+    api = _kube_api()
+    app = mod.create_app(
+        api, disable_auth=_env_flag("DISABLE_AUTH"),
+        prefix=os.environ.get("APP_PREFIX", ""))
+    _serve_wsgi(app, default_port)
+
+
+def cmd_controller_manager() -> int:
+    from kubeflow_rm_tpu.controlplane import (
+        WATCHED_KINDS,
+        make_cluster_manager,
+    )
+    api = _kube_api()
+    culler = {}
+    if os.environ.get("CULL_IDLE_TIME"):  # minutes, reference name
+        culler["cull_idle_minutes"] = float(os.environ["CULL_IDLE_TIME"])
+    if os.environ.get("IDLENESS_CHECK_PERIOD"):
+        culler["check_period_minutes"] = float(
+            os.environ["IDLENESS_CHECK_PERIOD"])
+    manager = make_cluster_manager(
+        api, enable_culling=_env_flag("ENABLE_CULLING"),
+        culler_config=culler or None)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *a: stop.set())
+    threads = [
+        threading.Thread(target=api.watch_kind, args=(kind, None, stop),
+                         daemon=True, name=f"watch-{kind}")
+        for kind in WATCHED_KINDS
+    ]
+    for t in threads:
+        t.start()
+    manager.enqueue_all()
+    logging.getLogger("kubeflow_rm_tpu").info(
+        "controller manager running (%d controllers, %d watches)",
+        len(manager.controllers), len(threads))
+    manager.run_forever(stop)
+    return 0
+
+
+def cmd_webhook_server() -> int:
+    from kubeflow_rm_tpu.controlplane.deploy.webhook_server import (
+        WebhookServer,
+        make_admission_handler,
+    )
+    api = _kube_api()
+    server = WebhookServer(
+        make_admission_handler(api),
+        port=int(os.environ.get("PORT", 8443)),
+        certfile=os.environ.get("WEBHOOK_TLS_CERT"),
+        keyfile=os.environ.get("WEBHOOK_TLS_KEY"),
+    )
+    port = server.start()
+    logging.getLogger("kubeflow_rm_tpu").info(
+        "webhook server on :%d (%s)", port,
+        "https" if server.certfile else "http")
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+def cmd_crds() -> int:
+    from kubeflow_rm_tpu.controlplane.deploy.crds import (
+        all_crds,
+        render_yaml,
+    )
+    sys.stdout.write(render_yaml(all_crds()))
+    return 0
+
+
+def cmd_manifests(outdir: str | None = None) -> int:
+    from kubeflow_rm_tpu.controlplane.deploy.manifests import write_tree
+    write_tree(outdir or "manifests")
+    return 0
+
+
+COMMANDS = {
+    "controller-manager": cmd_controller_manager,
+    "webhook-server": cmd_webhook_server,
+    "jupyter-web-app": lambda: _webapp("jupyter", 5000),
+    "volumes-web-app": lambda: _webapp("volumes", 5001),
+    "tensorboards-web-app": lambda: _webapp("tensorboards", 5002),
+    "kfam": lambda: _webapp("kfam", 8081),
+    "dashboard": lambda: _webapp("dashboard", 8082),
+    "crds": cmd_crds,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s | %(name)s | %(levelname)s | %(message)s")
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("commands:", ", ".join([*COMMANDS, "manifests"]))
+        return 0 if argv else 2
+    cmd, *rest = argv
+    if cmd == "manifests":
+        return cmd_manifests(rest[0] if rest else None)
+    if cmd not in COMMANDS:
+        print(f"unknown command {cmd!r}; known: "
+              f"{', '.join([*COMMANDS, 'manifests'])}", file=sys.stderr)
+        return 2
+    return COMMANDS[cmd]() or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Deployment path: everything that takes the control plane out of the
+in-memory test harness and onto a real cluster.
+
+The reference deploys via kubebuilder-generated CRDs
+(``notebook-controller/config/crd/bases/``), kustomize overlays
+(``config/overlays/``), controller processes (``main.go``) and an HTTPS
+admission server (``admission-webhook/main.go:755-773``). This package
+is the TPU build's equivalent:
+
+- ``crds``            — CRD manifests generated from the SAME api/*.py
+                        validators the in-memory apiserver enforces
+- ``kubeclient``      — the ``APIServer`` verb surface implemented
+                        against a real kube-apiserver over REST, so the
+                        SAME controllers/webhooks run in-cluster
+- ``webhook_server``  — HTTPS AdmissionReview v1 server wrapping the
+                        three webhook classes
+- ``manifests``       — kustomize tree renderer
+"""

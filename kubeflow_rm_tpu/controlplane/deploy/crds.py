@@ -1,0 +1,249 @@
+"""CRD manifests, generated from the platform's API modules.
+
+The reference's CRDs are kubebuilder-generated Go structs
+(``notebook-controller/config/crd/bases/kubeflow.org_notebooks.yaml``,
+``profile-controller/config/crd/bases/``, admission-webhook's
+PodDefault, tensorboard's and pvcviewer's). Here the Python API modules
+(``api/notebook.py``, ``api/profile.py``, ``api/poddefault.py``, the
+tensorboard/pvcviewer controllers) are the source of truth, and this
+module renders openAPIV3Schema CRDs from them — the acceleratorType
+enum comes live from ``api/tpu.py``'s topology table, so the CRD can
+never drift from what the controller schedules.
+
+``python -m kubeflow_rm_tpu.controlplane.deploy`` writes the YAML tree.
+"""
+
+from __future__ import annotations
+
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+
+# Free-form object: pod specs / quota specs / plugin configs — CRDs
+# model these as x-kubernetes-preserve-unknown-fields, exactly how the
+# reference embeds corev1.PodSpec.
+_ANY = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+
+def _crd(group: str, kind: str, plural: str, versions: list[dict],
+         scope: str = "Namespaced", short_names: list[str] | None = None,
+         categories: list[str] | None = None) -> dict:
+    names = {
+        "kind": kind,
+        "listKind": f"{kind}List",
+        "plural": plural,
+        "singular": kind.lower(),
+    }
+    if short_names:
+        names["shortNames"] = short_names
+    if categories:
+        names["categories"] = categories
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{group}"},
+        "spec": {
+            "group": group,
+            "names": names,
+            "scope": scope,
+            "versions": versions,
+        },
+    }
+
+
+def _version(name: str, schema: dict, *, served: bool = True,
+             storage: bool = True, status: bool = True,
+             printer_columns: list[dict] | None = None) -> dict:
+    v: dict = {
+        "name": name,
+        "served": served,
+        "storage": storage,
+        "schema": {"openAPIV3Schema": schema},
+    }
+    if status:
+        v["subresources"] = {"status": {}}
+    if printer_columns:
+        v["additionalPrinterColumns"] = printer_columns
+    return v
+
+
+def notebook_crd() -> dict:
+    """kubeflow.org/v1 Notebook with the first-class ``spec.tpu`` block
+    (the validator in ``api/notebook.py:validate`` rendered as schema)."""
+    tpu_block = {
+        "type": "object",
+        "required": ["acceleratorType"],
+        "properties": {
+            "acceleratorType": {
+                "type": "string",
+                # live from the topology table: quota, scheduling and
+                # the spawner picker all share this vocabulary
+                "enum": sorted(tpu_api.TOPOLOGIES),
+            },
+            "numSlices": {
+                "type": "integer",
+                "minimum": 1,
+                "description": "Multislice width: >1 renders a DCN job "
+                               "of identical slices with MEGASCALE_* "
+                               "rendezvous injected.",
+            },
+        },
+    }
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["template"],
+                "properties": {
+                    "template": {
+                        "type": "object",
+                        "required": ["spec"],
+                        "properties": {"spec": _ANY},
+                    },
+                    "tpu": tpu_block,
+                },
+            },
+            "status": {
+                "type": "object",
+                "properties": {
+                    "conditions": {"type": "array", "items": _ANY},
+                    "readyReplicas": {"type": "integer"},
+                    "containerState": _ANY,
+                },
+            },
+        },
+    }
+    cols = [
+        {"name": "Accelerator", "type": "string",
+         "jsonPath": ".spec.tpu.acceleratorType"},
+        {"name": "Ready", "type": "integer",
+         "jsonPath": ".status.readyReplicas"},
+        {"name": "Age", "type": "date",
+         "jsonPath": ".metadata.creationTimestamp"},
+    ]
+    return _crd("kubeflow.org", "Notebook", "notebooks",
+                [_version("v1", schema, printer_columns=cols)],
+                short_names=["nb"], categories=["kubeflow"])
+
+
+def profile_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["owner"],
+                "properties": {
+                    "owner": {
+                        "type": "object",
+                        "required": ["kind", "name"],
+                        "properties": {
+                            "kind": {"type": "string",
+                                     "enum": ["User", "Group",
+                                              "ServiceAccount"]},
+                            "name": {"type": "string"},
+                        },
+                    },
+                    "resourceQuotaSpec": _ANY,
+                    "plugins": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["kind"],
+                            "properties": {"kind": {"type": "string"},
+                                           "spec": _ANY},
+                        },
+                    },
+                },
+            },
+            "status": _ANY,
+        },
+    }
+    return _crd("kubeflow.org", "Profile", "profiles",
+                [_version("v1", schema)], scope="Cluster")
+
+
+def poddefault_crd() -> dict:
+    from kubeflow_rm_tpu.controlplane.api.poddefault import MERGE_FIELDS
+    props: dict = {
+        "selector": _ANY,
+        "desc": {"type": "string"},
+        "serviceAccountName": {"type": "string"},
+        "automountServiceAccountToken": {"type": "boolean"},
+        "labels": {"type": "object",
+                   "additionalProperties": {"type": "string"}},
+        "annotations": {"type": "object",
+                        "additionalProperties": {"type": "string"}},
+        "command": {"type": "array", "items": {"type": "string"}},
+        "args": {"type": "array", "items": {"type": "string"}},
+    }
+    for f in MERGE_FIELDS:
+        props[f] = {"type": "array", "items": _ANY}
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["selector"],
+                "properties": props,
+            },
+        },
+    }
+    return _crd("kubeflow.org", "PodDefault", "poddefaults",
+                [_version("v1alpha1", schema, status=False)])
+
+
+def tensorboard_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["logspath"],
+                "properties": {
+                    "logspath": {
+                        "type": "string",
+                        "description": "pvc://claim/sub/path or "
+                                       "gs://bucket/path",
+                    },
+                },
+            },
+            "status": _ANY,
+        },
+    }
+    cols = [{"name": "Logspath", "type": "string",
+             "jsonPath": ".spec.logspath"}]
+    return _crd("tensorboard.kubeflow.org", "Tensorboard", "tensorboards",
+                [_version("v1alpha1", schema, printer_columns=cols)])
+
+
+def pvcviewer_crd() -> dict:
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["pvc"],
+                "properties": {
+                    "pvc": {"type": "string"},
+                    "podSpec": _ANY,
+                    "networking": _ANY,
+                    "rwoScheduling": {"type": "boolean"},
+                },
+            },
+            "status": _ANY,
+        },
+    }
+    return _crd("kubeflow.org", "PVCViewer", "pvcviewers",
+                [_version("v1alpha1", schema)])
+
+
+def all_crds() -> list[dict]:
+    return [notebook_crd(), profile_crd(), poddefault_crd(),
+            tensorboard_crd(), pvcviewer_crd()]
+
+
+def render_yaml(objs: list[dict]) -> str:
+    import yaml
+    return "---\n".join(
+        yaml.safe_dump(o, sort_keys=False, default_flow_style=False)
+        for o in objs)

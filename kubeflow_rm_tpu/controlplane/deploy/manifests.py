@@ -1,0 +1,335 @@
+"""Kustomize manifest tree for the control plane.
+
+Mirrors the reference's kubebuilder layout
+(``notebook-controller/config/{crd,rbac,manager,webhook,default}`` and
+``config/overlays/{standalone,...}``) with the TPU build's shape: one
+controller-manager Deployment (all reconcilers in one process), one
+webhook Deployment (HTTPS admission), five web-app Deployments, and
+the CRDs from ``crds.py``. ``python -m kubeflow_rm_tpu.controlplane
+manifests [dir]`` writes the tree; the checked-in ``manifests/`` dir is
+its output (CI asserts they're in sync).
+"""
+
+from __future__ import annotations
+
+import os
+
+IMAGE = "kubeflow-rm-tpu/controlplane"
+NAMESPACE = "kubeflow"
+APP_LABEL = "app.kubernetes.io/part-of"
+
+
+def _deployment(name: str, command: list[str], *, port: int,
+                sa: str = "controlplane", env: list[dict] | None = None,
+                volumes: list[dict] | None = None,
+                mounts: list[dict] | None = None,
+                probe_path: str = "/healthz") -> dict:
+    container = {
+        "name": name,
+        "image": IMAGE,
+        "command": command,
+        "ports": [{"containerPort": port}],
+        "env": env or [],
+        "readinessProbe": {
+            "httpGet": {"path": probe_path, "port": port,
+                        **({"scheme": "HTTPS"} if name == "webhook"
+                           else {})},
+            "initialDelaySeconds": 3,
+        },
+        "resources": {
+            "requests": {"cpu": "100m", "memory": "128Mi"},
+            "limits": {"cpu": "1", "memory": "512Mi"},
+        },
+    }
+    if mounts:
+        container["volumeMounts"] = mounts
+    pod_spec: dict = {"serviceAccountName": sa,
+                      "containers": [container]}
+    if volumes:
+        pod_spec["volumes"] = volumes
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name,
+                     "labels": {APP_LABEL: "kubeflow-rm-tpu",
+                                "app": name}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def _service(name: str, port: int, target: int | None = None) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "labels": {"app": name}},
+        "spec": {"selector": {"app": name},
+                 "ports": [{"port": port,
+                            "targetPort": target or port}]},
+    }
+
+
+def _webapp_pair(name: str, cmd: str, port: int) -> list[dict]:
+    return [
+        _deployment(name, ["python", "-m",
+                           "kubeflow_rm_tpu.controlplane", cmd],
+                    port=port, probe_path="/healthz",
+                    env=[{"name": "PORT", "value": str(port)},
+                         {"name": "APP_PREFIX", "value": f"/{cmd}"}]),
+        _service(name, 80, port),
+    ]
+
+
+def controller_manager_objects() -> list[dict]:
+    dep = _deployment(
+        "controller-manager",
+        ["python", "-m", "kubeflow_rm_tpu.controlplane",
+         "controller-manager"],
+        port=8081,
+        env=[{"name": "ENABLE_CULLING", "value": "true"},
+             {"name": "CULL_IDLE_TIME", "value": "1440"},
+             {"name": "IDLENESS_CHECK_PERIOD", "value": "1"}],
+    )
+    # the manager serves no HTTP; probe is exec-based liveness instead
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    del c["readinessProbe"]
+    del c["ports"]
+    c["livenessProbe"] = {
+        "exec": {"command": ["python", "-c", "import kubeflow_rm_tpu"]},
+        "periodSeconds": 60,
+    }
+    return [dep]
+
+
+def webhook_objects() -> list[dict]:
+    dep = _deployment(
+        "webhook", ["python", "-m", "kubeflow_rm_tpu.controlplane",
+                    "webhook-server"],
+        port=8443,
+        env=[{"name": "WEBHOOK_TLS_CERT",
+              "value": "/etc/webhook/certs/tls.crt"},
+             {"name": "WEBHOOK_TLS_KEY",
+              "value": "/etc/webhook/certs/tls.key"}],
+        volumes=[{"name": "certs",
+                  "secret": {"secretName": "webhook-server-cert"}}],
+        mounts=[{"name": "certs", "mountPath": "/etc/webhook/certs",
+                 "readOnly": True}],
+    )
+    svc = _service("webhook", 443, 8443)
+    cfg = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "MutatingWebhookConfiguration",
+        "metadata": {"name": "kubeflow-rm-tpu-mutating"},
+        "webhooks": [
+            {
+                "name": "notebooks.kubeflow.org",
+                "admissionReviewVersions": ["v1"],
+                "sideEffects": "None",
+                "failurePolicy": "Fail",
+                "clientConfig": {
+                    "service": {"name": "webhook",
+                                "namespace": NAMESPACE,
+                                "path": "/mutate-notebook",
+                                "port": 443},
+                    # caBundle patched in by the overlay / cert-manager
+                },
+                "rules": [{"apiGroups": ["kubeflow.org"],
+                           "apiVersions": ["v1"],
+                           "operations": ["CREATE", "UPDATE"],
+                           "resources": ["notebooks"]}],
+            },
+            {
+                "name": "pods.kubeflow.org",
+                "admissionReviewVersions": ["v1"],
+                "sideEffects": "None",
+                # pods must start even if the webhook is down — the
+                # reference's PodDefault webhook is Ignore too
+                "failurePolicy": "Ignore",
+                "namespaceSelector": {
+                    "matchLabels": {
+                        "app.kubernetes.io/part-of": "kubeflow-profile"},
+                },
+                "clientConfig": {
+                    "service": {"name": "webhook",
+                                "namespace": NAMESPACE,
+                                "path": "/mutate-pod",
+                                "port": 443},
+                },
+                "rules": [{"apiGroups": [""],
+                           "apiVersions": ["v1"],
+                           "operations": ["CREATE"],
+                           "resources": ["pods"]}],
+            },
+        ],
+    }
+    return [dep, svc, cfg]
+
+
+def rbac_objects() -> list[dict]:
+    sa = {"apiVersion": "v1", "kind": "ServiceAccount",
+          "metadata": {"name": "controlplane"}}
+    # everything the reconcilers + web apps touch (the union of the
+    # reference's per-component roles)
+    rules = [
+        {"apiGroups": ["kubeflow.org", "tensorboard.kubeflow.org"],
+         "resources": ["notebooks", "notebooks/status", "profiles",
+                       "profiles/status", "poddefaults", "pvcviewers",
+                       "pvcviewers/status", "tensorboards",
+                       "tensorboards/status"],
+         "verbs": ["*"]},
+        {"apiGroups": [""],
+         "resources": ["namespaces", "services", "serviceaccounts",
+                       "configmaps", "secrets", "events", "pods",
+                       "pods/log", "resourcequotas",
+                       "persistentvolumeclaims", "nodes"],
+         "verbs": ["*"]},
+        {"apiGroups": ["apps"],
+         "resources": ["statefulsets", "deployments"],
+         "verbs": ["*"]},
+        {"apiGroups": ["rbac.authorization.k8s.io"],
+         "resources": ["rolebindings", "clusterroles",
+                       "clusterrolebindings"],
+         "verbs": ["*"]},
+        {"apiGroups": ["networking.k8s.io"],
+         "resources": ["networkpolicies"], "verbs": ["*"]},
+        {"apiGroups": ["networking.istio.io", "security.istio.io"],
+         "resources": ["virtualservices", "authorizationpolicies"],
+         "verbs": ["*"]},
+        {"apiGroups": ["route.openshift.io"], "resources": ["routes"],
+         "verbs": ["*"]},
+        {"apiGroups": ["authorization.k8s.io"],
+         "resources": ["subjectaccessreviews"], "verbs": ["create"]},
+    ]
+    role = {"apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "kubeflow-rm-tpu-manager"},
+            "rules": rules}
+    rb = {"apiVersion": "rbac.authorization.k8s.io/v1",
+          "kind": "ClusterRoleBinding",
+          "metadata": {"name": "kubeflow-rm-tpu-manager"},
+          "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                      "kind": "ClusterRole",
+                      "name": "kubeflow-rm-tpu-manager"},
+          "subjects": [{"kind": "ServiceAccount", "name": "controlplane",
+                        "namespace": NAMESPACE}]}
+    # the user-facing aggregated roles the profile controller binds
+    user_roles = []
+    for name, verbs in (("kubeflow-admin", ["*"]),
+                        ("kubeflow-edit", ["*"]),
+                        ("kubeflow-view", ["get", "list", "watch"])):
+        user_roles.append({
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": name},
+            "rules": [
+                {"apiGroups": ["kubeflow.org",
+                               "tensorboard.kubeflow.org"],
+                 "resources": ["notebooks", "poddefaults",
+                               "tensorboards", "pvcviewers"],
+                 "verbs": verbs},
+                {"apiGroups": [""],
+                 "resources": ["persistentvolumeclaims", "events",
+                               "pods", "pods/log", "configmaps"],
+                 "verbs": verbs if verbs == ["*"]
+                 else ["get", "list", "watch"]},
+            ],
+        })
+    return [sa, role, rb, *user_roles]
+
+
+def webapp_objects() -> list[dict]:
+    objs: list[dict] = []
+    for name, cmd, port in (
+            ("jupyter-web-app", "jupyter-web-app", 5000),
+            ("volumes-web-app", "volumes-web-app", 5001),
+            ("tensorboards-web-app", "tensorboards-web-app", 5002),
+            ("kfam", "kfam", 8081),
+            ("dashboard", "dashboard", 8082)):
+        objs.extend(_webapp_pair(name, cmd, port))
+    return objs
+
+
+def _kustomization(resources: list[str], *, namespace: str | None = None,
+                   extra: dict | None = None) -> dict:
+    k: dict = {"apiVersion": "kustomize.config.k8s.io/v1beta1",
+               "kind": "Kustomization",
+               "resources": resources}
+    if namespace:
+        k["namespace"] = namespace
+    if extra:
+        k.update(extra)
+    return k
+
+
+def write_tree(outdir: str) -> list[str]:
+    """Write the full kustomize tree; returns the files written."""
+    import yaml
+
+    from kubeflow_rm_tpu.controlplane.deploy.crds import all_crds
+
+    def dump(objs) -> str:
+        if isinstance(objs, dict):
+            objs = [objs]
+        return "---\n".join(
+            yaml.safe_dump(o, sort_keys=False) for o in objs)
+
+    files: dict[str, str] = {}
+
+    crd_files = []
+    for crd in all_crds():
+        fname = f"crd/bases/{crd['metadata']['name']}.yaml"
+        files[fname] = dump(crd)
+        crd_files.append(os.path.basename(fname))
+    files["crd/kustomization.yaml"] = dump(_kustomization(
+        [f"bases/{f}" for f in crd_files]))
+
+    files["rbac/rbac.yaml"] = dump(rbac_objects())
+    files["rbac/kustomization.yaml"] = dump(_kustomization(["rbac.yaml"]))
+
+    files["manager/manager.yaml"] = dump(controller_manager_objects())
+    files["manager/kustomization.yaml"] = dump(
+        _kustomization(["manager.yaml"]))
+
+    files["webhook/webhook.yaml"] = dump(webhook_objects())
+    files["webhook/kustomization.yaml"] = dump(
+        _kustomization(["webhook.yaml"]))
+
+    files["webapps/webapps.yaml"] = dump(webapp_objects())
+    files["webapps/kustomization.yaml"] = dump(
+        _kustomization(["webapps.yaml"]))
+
+    files["default/kustomization.yaml"] = dump(_kustomization(
+        ["../crd", "../rbac", "../manager", "../webhook", "../webapps",
+         "namespace.yaml"],
+        namespace=NAMESPACE,
+        extra={"images": [{"name": IMAGE,
+                           "newName": IMAGE, "newTag": "latest"}]}))
+    files["default/namespace.yaml"] = dump({
+        "apiVersion": "v1", "kind": "Namespace",
+        "metadata": {"name": NAMESPACE}})
+
+    # overlays: standalone (plain) and kind (CI: local image, no TLS
+    # verification dance — cert generated by the e2e script)
+    files["overlays/standalone/kustomization.yaml"] = dump(
+        _kustomization(["../../default"]))
+    files["overlays/kind/kustomization.yaml"] = dump(_kustomization(
+        ["../../default"],
+        extra={"images": [{"name": IMAGE,
+                           "newName": "localhost/kubeflow-rm-tpu",
+                           "newTag": "ci"}]}))
+
+    written = []
+    for rel, content in files.items():
+        path = os.path.join(outdir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(content if content.endswith("\n")
+                    else content + "\n")
+        written.append(path)
+    return written

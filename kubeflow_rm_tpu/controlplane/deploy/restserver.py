@@ -1,0 +1,299 @@
+"""Kube-style REST facade over the in-memory ``APIServer``.
+
+Serves the in-memory apiserver's store on real HTTP with the Kubernetes
+path/verb/status-code conventions — ``/api/v1/...`` and
+``/apis/<group>/<version>/...`` collections, merge-patch, the status
+subresource, label selectors, SubjectAccessReview, and streaming
+``?watch=true``. Three jobs:
+
+1. Round-trip testing of ``deploy.kubeclient.KubeAPIServer``: the
+   adapter is exercised against real kube REST semantics with no
+   cluster (the role envtest plays in the reference —
+   ``suite_test.go:50-110``).
+2. Wall-clock conformance: web apps, webhook server and the controller
+   manager run as real processes/threads against this server over
+   sockets, so provisioning p50 is measured in wall time (BASELINE.json
+   primary metric), not reconcile counts.
+3. A fake-cluster e2e harness for CI without KinD credentials.
+
+Admission/validation run INSIDE the wrapped APIServer (its registered
+chains), so writes through this facade behave like a cluster whose
+webhooks are installed — or construct the APIServer bare and register
+nothing to model a cluster with no webhooks.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from kubeflow_rm_tpu.controlplane.apiserver import (
+    AdmissionDenied,
+    AlreadyExists,
+    APIServer,
+    Conflict,
+    Invalid,
+    NotFound,
+)
+from kubeflow_rm_tpu.controlplane.deploy.kubeclient import RESOURCES
+
+log = logging.getLogger("kubeflow_rm_tpu.restserver")
+
+# plural -> kind (reverse of the adapter's table, so both sides agree)
+PLURALS: dict[str, str] = {
+    plural: kind for kind, (_, plural, _ns) in RESOURCES.items()
+}
+
+
+def _status(code: int, reason: str, message: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Status", "status": "Failure",
+            "code": code, "reason": reason, "message": message}
+
+
+def _selector_from(params: dict) -> dict | None:
+    raw = params.get("labelSelector", [None])[0]
+    if not raw:
+        return None
+    pairs = {}
+    for part in raw.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            pairs[k.removesuffix("=")] = v
+    return {"matchLabels": pairs}
+
+
+class _Route:
+    """Parsed collection/object path."""
+
+    def __init__(self, kind: str, namespace: str | None,
+                 name: str | None, subresource: str | None):
+        self.kind, self.namespace = kind, namespace
+        self.name, self.subresource = name, subresource
+
+
+def _parse_path(path: str) -> _Route | None:
+    parts = [p for p in path.split("/") if p]
+    # /api/v1/... or /apis/<group>/<version>/...
+    if not parts:
+        return None
+    if parts[0] == "api" and len(parts) >= 2:
+        rest = parts[2:]
+    elif parts[0] == "apis" and len(parts) >= 3:
+        rest = parts[3:]
+    else:
+        return None
+    namespace = None
+    # /namespaces/{ns}/{plural}... (>=3 segments) is a namespaced
+    # collection; /namespaces[/{name}] is the Namespace kind itself
+    if len(rest) >= 3 and rest[0] == "namespaces":
+        namespace = rest[1]
+        rest = rest[2:]
+    if not rest or rest[0] not in PLURALS:
+        return None
+    kind = PLURALS[rest[0]]
+    name = rest[1] if len(rest) > 1 else None
+    sub = rest[2] if len(rest) > 2 else None
+    return _Route(kind, namespace, name, sub)
+
+
+class RestServer:
+    def __init__(self, api: APIServer, *, port: int = 0):
+        self.api = api
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        # watch fan-out: every active watch request owns a queue fed by
+        # the apiserver watcher below
+        self._watch_queues: list[tuple[str, queue.Queue]] = []
+        self._watch_lock = threading.Lock()
+        api.add_watcher(self._on_event)
+
+    def _on_event(self, etype: str, obj: dict, old) -> None:
+        with self._watch_lock:
+            for kind, q in self._watch_queues:
+                if obj.get("kind") == kind:
+                    q.put({"type": {"ADDED": "ADDED",
+                                    "MODIFIED": "MODIFIED",
+                                    "DELETED": "DELETED"}.get(etype,
+                                                              etype),
+                           "object": obj})
+
+    # ---- request handling -------------------------------------------
+    def _handle(self, handler: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(handler.path)
+        params = parse_qs(parsed.query)
+        method = handler.command
+
+        if parsed.path == "/apis/authorization.k8s.io/v1/subjectaccessreviews" \
+                and method == "POST":
+            body = self._read_json(handler)
+            attrs = (body.get("spec") or {}).get(
+                "resourceAttributes") or {}
+            allowed = self.api.access_review(
+                (body.get("spec") or {}).get("user"),
+                attrs.get("verb", ""), attrs.get("resource", ""),
+                attrs.get("namespace"))
+            body.setdefault("status", {})["allowed"] = allowed
+            self._send(handler, 201, body)
+            return
+        if parsed.path in ("/healthz", "/readyz", "/livez"):
+            self._send_raw(handler, 200, b"ok",
+                           content_type="text/plain")
+            return
+
+        route = _parse_path(parsed.path)
+        if route is None:
+            self._send(handler, 404,
+                       _status(404, "NotFound",
+                               f"no route for {parsed.path}"))
+            return
+        try:
+            self._dispatch(handler, method, route, params)
+        except NotFound as e:
+            self._send(handler, 404, _status(404, "NotFound", str(e)))
+        except AlreadyExists as e:
+            self._send(handler, 409,
+                       _status(409, "AlreadyExists", str(e)))
+        except Conflict as e:
+            self._send(handler, 409, _status(409, "Conflict", str(e)))
+        except (Invalid, AdmissionDenied) as e:
+            self._send(handler, 422, _status(422, "Invalid", str(e)))
+        except Exception as e:  # pragma: no cover - defensive
+            log.exception("unhandled")
+            self._send(handler, 500,
+                       _status(500, "InternalError", str(e)))
+
+    def _dispatch(self, handler, method: str, route: _Route,
+                  params: dict) -> None:
+        api, kind = self.api, route.kind
+        if method == "GET" and route.name is None:
+            if params.get("watch", ["false"])[0] == "true":
+                self._serve_watch(handler, route, params)
+                return
+            items = api.list(kind, route.namespace,
+                             _selector_from(params))
+            self._send(handler, 200, {
+                "apiVersion": "v1", "kind": f"{kind}List",
+                "metadata": {"resourceVersion": str(api._rv)},
+                "items": items,
+            })
+        elif method == "GET":
+            self._send(handler, 200,
+                       api.get(kind, route.name, route.namespace))
+        elif method == "POST":
+            obj = self._read_json(handler)
+            obj.setdefault("kind", kind)
+            if route.namespace and not obj["metadata"].get("namespace"):
+                obj["metadata"]["namespace"] = route.namespace
+            self._send(handler, 201, api.create(obj))
+        elif method == "PUT":
+            obj = self._read_json(handler)
+            obj.setdefault("kind", kind)
+            self._send(handler, 200, api.update(obj))
+        elif method == "PATCH":
+            patch = self._read_json(handler)
+            if route.subresource == "status":
+                current = api.get(kind, route.name, route.namespace)
+                current["status"] = patch.get("status", {})
+                self._send(handler, 200, api.update_status(current))
+            else:
+                self._send(handler, 200,
+                           api.patch(kind, route.name, patch,
+                                     route.namespace))
+        elif method == "DELETE":
+            obj = api.get(kind, route.name, route.namespace)
+            api.delete(kind, route.name, route.namespace)
+            self._send(handler, 200, obj)
+        else:
+            self._send(handler, 405,
+                       _status(405, "MethodNotAllowed", method))
+
+    def _serve_watch(self, handler, route: _Route, params: dict) -> None:
+        q: queue.Queue = queue.Queue()
+        with self._watch_lock:
+            self._watch_queues.append((route.kind, q))
+        timeout = float(params.get("timeoutSeconds", ["300"])[0])
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.end_headers()
+
+            def write_chunk(data: bytes):
+                handler.wfile.write(f"{len(data):x}\r\n".encode())
+                handler.wfile.write(data + b"\r\n")
+                handler.wfile.flush()
+
+            import time
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    evt = q.get(timeout=min(remaining, 1.0))
+                except queue.Empty:
+                    continue
+                if route.namespace and (
+                        (evt["object"].get("metadata") or {})
+                        .get("namespace")) != route.namespace:
+                    continue
+                write_chunk(json.dumps(evt).encode() + b"\n")
+            write_chunk(b"")  # terminal chunk
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            with self._watch_lock:
+                try:
+                    self._watch_queues.remove((route.kind, q))
+                except ValueError:
+                    pass
+
+    # ---- plumbing ----------------------------------------------------
+    @staticmethod
+    def _read_json(handler) -> dict:
+        length = int(handler.headers.get("Content-Length", "0"))
+        return json.loads(handler.rfile.read(length) or b"{}")
+
+    @staticmethod
+    def _send(handler, code: int, body: dict) -> None:
+        RestServer._send_raw(handler, code, json.dumps(body).encode())
+
+    @staticmethod
+    def _send_raw(handler, code: int, data: bytes,
+                  content_type: str = "application/json") -> None:
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    def start(self) -> int:
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _go(self):
+                outer._handle(self)
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _go
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), H)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()

@@ -152,6 +152,31 @@ class Manager:
             f"(hot objects: { {c.name: sorted(self._queues[c.name]) for c in self.controllers if self._queues[c.name]} })"
         )
 
+    def run_forever(self, stop=None, poll_interval_s: float = 1.0,
+                    on_error: Callable | None = None) -> None:
+        """In-cluster serving loop: drain the queues whenever watch
+        events (fanned into ``_on_event`` by the kube adapter's watch
+        threads) or timed requeues produce work; sleep ``poll_interval_s``
+        between drains. ``stop`` is a ``threading.Event``; reconcile
+        errors that exhaust retries go to ``on_error`` (default: log)."""
+        import logging
+        import threading
+        stop = stop or threading.Event()
+        logger = logging.getLogger("kubeflow_rm_tpu.manager")
+        while not stop.is_set():
+            try:
+                self.run_until_idle()
+            except RuntimeError as e:
+                logger.error("manager drain failed: %s", e)
+            for cname, req, err in self.errors:
+                if on_error:
+                    on_error(cname, req, err)
+                else:
+                    logger.error("%s %s gave up after retries: %s",
+                                 cname, req, err)
+            self.errors.clear()
+            stop.wait(poll_interval_s)
+
     def _retry(self, c: Controller, req: Request, e: Exception) -> None:
         from kubeflow_rm_tpu.controlplane import metrics
         metrics.RECONCILE_ERRORS_TOTAL.labels(controller=c.name).inc()

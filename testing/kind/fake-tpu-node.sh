@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Stamp KinD workers as fake TPU hosts: the GKE node labels the
+# scheduler matches (api/tpu.py NODE_LABEL_*) plus google.com/tpu
+# extended-resource capacity via the status subresource — the "fake
+# TPU inventory" SURVEY.md §4 prescribes for cluster tests.
+#
+# Usage: fake-tpu-node.sh <accelerator> <topology> <chips-per-host> [nodes...]
+set -euo pipefail
+
+ACCEL="${1:?accelerator, e.g. tpu-v5p-slice}"
+TOPO="${2:?topology, e.g. 2x2x2}"
+CHIPS="${3:?chips per host, e.g. 4}"
+shift 3
+NODES=("$@")
+if [ ${#NODES[@]} -eq 0 ]; then
+  mapfile -t NODES < <(kubectl get nodes -o name | grep -v control-plane)
+fi
+
+for node in "${NODES[@]}"; do
+  name="${node#node/}"
+  kubectl label --overwrite "node/${name}" \
+    "cloud.google.com/gke-tpu-accelerator=${ACCEL}" \
+    "cloud.google.com/gke-tpu-topology=${TOPO}"
+  kubectl patch "node/${name}" --subresource=status --type=merge \
+    -p "{\"status\":{\"capacity\":{\"google.com/tpu\":\"${CHIPS}\"},\"allocatable\":{\"google.com/tpu\":\"${CHIPS}\"}}}"
+  echo "faked TPU host: ${name} (${ACCEL} ${TOPO}, ${CHIPS} chips)"
+done

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Self-signed serving cert for the admission server (the job
+# cert-manager does in production overlays): creates the
+# webhook-server-cert Secret and patches the generated CA into the
+# MutatingWebhookConfiguration's clientConfig.caBundle.
+set -euo pipefail
+
+NS="${1:-kubeflow}"
+SVC="webhook"
+DIR="$(mktemp -d)"
+trap 'rm -rf "$DIR"' EXIT
+
+openssl req -x509 -newkey rsa:2048 -nodes -days 3650 \
+  -keyout "$DIR/ca.key" -out "$DIR/ca.crt" \
+  -subj "/CN=kubeflow-rm-tpu-webhook-ca" >/dev/null 2>&1
+
+openssl req -newkey rsa:2048 -nodes \
+  -keyout "$DIR/tls.key" -out "$DIR/tls.csr" \
+  -subj "/CN=${SVC}.${NS}.svc" >/dev/null 2>&1
+
+cat > "$DIR/ext.cnf" <<EOF
+subjectAltName=DNS:${SVC}.${NS}.svc,DNS:${SVC}.${NS}.svc.cluster.local
+EOF
+openssl x509 -req -in "$DIR/tls.csr" -CA "$DIR/ca.crt" \
+  -CAkey "$DIR/ca.key" -CAcreateserial -days 3650 \
+  -extfile "$DIR/ext.cnf" -out "$DIR/tls.crt" >/dev/null 2>&1
+
+kubectl -n "$NS" create secret tls webhook-server-cert \
+  --cert="$DIR/tls.crt" --key="$DIR/tls.key" \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+CA_BUNDLE="$(base64 -w0 < "$DIR/ca.crt")"
+PATCH="[
+  {\"op\":\"add\",\"path\":\"/webhooks/0/clientConfig/caBundle\",\"value\":\"${CA_BUNDLE}\"},
+  {\"op\":\"add\",\"path\":\"/webhooks/1/clientConfig/caBundle\",\"value\":\"${CA_BUNDLE}\"}
+]"
+kubectl patch mutatingwebhookconfiguration kubeflow-rm-tpu-mutating \
+  --type=json -p "$PATCH"
+echo "webhook certs installed"

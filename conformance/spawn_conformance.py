@@ -101,7 +101,11 @@ def wallclock_main(args) -> int:
     kubelet.add(DeploymentController(auto_ready=True))
     accel = args.slices.split(",")[0].split("=")[0]
     topo = tpu_api.lookup(accel)
-    count = int(args.slices.split(",")[0].split("=")[1])
+    # wallclock measures provisioning latency, so the fleet must cover
+    # every spawn (fleet-exhaustion semantics are the in-process mode's
+    # job); notebooks stay up for the whole run
+    count = max(int(args.slices.split(",")[0].split("=")[1]),
+                args.notebooks)
     for s in range(count):
         for h in range(topo.hosts):
             capi.create(make_tpu_node(f"{accel}-s{s}-h{h}", accel))
@@ -172,15 +176,18 @@ def wallclock_main(args) -> int:
             # SPA's status ladder does)
             slice_deadline = time.monotonic() + 60
             while True:
-                nbs = session.get(
-                    f"{jwa_url}/api/namespaces/conformance/notebooks"
-                ).json()["notebooks"]
-                mine = [n for n in nbs if n["name"] == f"wc-{i}"]
-                if mine and mine[0].get("readyReplicas") == topo.hosts:
+                # the list endpoint serves summaries without replica
+                # counts; the per-notebook GET returns the raw CR
+                resp = session.get(
+                    f"{jwa_url}/api/namespaces/conformance/notebooks/wc-{i}")
+                nb = resp.json().get("notebook", {}) \
+                    if resp.status_code == 200 else {}
+                if (nb.get("status") or {}).get(
+                        "readyReplicas") == topo.hosts:
                     break
                 if time.monotonic() > slice_deadline:
                     raise AssertionError(
-                        f"wc-{i} never ready: {mine}")
+                        f"wc-{i} never ready: {nb.get('status')}")
                 time.sleep(0.02)
             latencies.append(time.perf_counter() - t0)
     finally:

@@ -106,20 +106,32 @@ class RestServer:
         self.port = port
         self._httpd: ThreadingHTTPServer | None = None
         # watch fan-out: every active watch request owns a queue fed by
-        # the apiserver watcher below
+        # the apiserver watcher below. A bounded backlog of recent
+        # events (rv-ordered) lets a watch opened with
+        # ?resourceVersion=N replay what landed between the client's
+        # list and its watch registration — without it, any write in
+        # that gap (or between 300s stream restarts) reaches no watcher
+        # until the next full relist.
+        import collections
         self._watch_queues: list[tuple[str, queue.Queue]] = []
+        self._backlog: collections.deque = collections.deque(maxlen=2048)
         self._watch_lock = threading.Lock()
         api.add_watcher(self._on_event)
 
     def _on_event(self, etype: str, obj: dict, old) -> None:
+        evt = {"type": {"ADDED": "ADDED",
+                        "MODIFIED": "MODIFIED",
+                        "DELETED": "DELETED"}.get(etype, etype),
+               "object": obj}
+        try:
+            rv = int((obj.get("metadata") or {}).get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            rv = 0
         with self._watch_lock:
+            self._backlog.append((rv, obj.get("kind"), evt))
             for kind, q in self._watch_queues:
                 if obj.get("kind") == kind:
-                    q.put({"type": {"ADDED": "ADDED",
-                                    "MODIFIED": "MODIFIED",
-                                    "DELETED": "DELETED"}.get(etype,
-                                                              etype),
-                           "object": obj})
+                    q.put(evt)
 
     # ---- request handling -------------------------------------------
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
@@ -213,7 +225,17 @@ class RestServer:
 
     def _serve_watch(self, handler, route: _Route, params: dict) -> None:
         q: queue.Queue = queue.Queue()
+        try:
+            since_rv = int(params.get("resourceVersion", ["0"])[0] or 0)
+        except ValueError:
+            since_rv = 0
         with self._watch_lock:
+            # replay-then-register atomically vs _on_event: events with
+            # rv > the client's list rv land in q exactly once
+            if since_rv:
+                for rv, kind, evt in self._backlog:
+                    if kind == route.kind and rv > since_rv:
+                        q.put(evt)
             self._watch_queues.append((route.kind, q))
         timeout = float(params.get("timeoutSeconds", ["300"])[0])
         try:

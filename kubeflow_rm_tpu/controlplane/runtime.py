@@ -78,9 +78,14 @@ class Manager:
     MAX_RETRIES = 5
 
     def __init__(self, api: APIServer):
+        import threading
         self.api = api
         self.controllers: list[Controller] = []
         self._queues: dict[str, set[Request]] = {}
+        # guards _queues: in the run_forever deployment the kube
+        # adapter's per-kind watch threads enqueue via _on_event while
+        # the serving thread drains
+        self._queue_lock = threading.Lock()
         # (due_time, controller_name, request)
         self._timed: list[tuple[datetime.datetime, str, Request]] = []
         self._retries: dict[tuple[str, Request], int] = {}
@@ -95,7 +100,8 @@ class Manager:
 
     def enqueue(self, controller: Controller | str, req: Request) -> None:
         name = controller if isinstance(controller, str) else controller.name
-        self._queues[name].add(req)
+        with self._queue_lock:
+            self._queues[name].add(req)
 
     def enqueue_all(self) -> None:
         """Seed every controller's queue with all existing primaries
@@ -125,14 +131,16 @@ class Manager:
         injected clock passes them). Returns reconcile count."""
         count = 0
         for _ in range(max_iterations):
-            for cname, req in self._due_timed():
-                self._queues[cname].add(req)
-            pending = [(c, req) for c in self.controllers
-                       for req in sorted(self._queues[c.name])]
+            with self._queue_lock:
+                for cname, req in self._due_timed():
+                    self._queues[cname].add(req)
+                pending = [(c, req) for c in self.controllers
+                           for req in sorted(self._queues[c.name])]
             if not pending:
                 return count
             for c, req in pending:
-                self._queues[c.name].discard(req)
+                with self._queue_lock:
+                    self._queues[c.name].discard(req)
                 count += 1
                 try:
                     requeue_after = c.reconcile(self.api, req)
@@ -147,9 +155,12 @@ class Manager:
                     pass  # object vanished; level-triggered — nothing to do
                 except Exception as e:  # reconcile error: retry w/ backoff
                     self._retry(c, req, e)
+        with self._queue_lock:
+            hot = {c.name: sorted(self._queues[c.name])
+                   for c in self.controllers if self._queues[c.name]}
         raise RuntimeError(
             f"manager did not quiesce in {max_iterations} iterations "
-            f"(hot objects: { {c.name: sorted(self._queues[c.name]) for c in self.controllers if self._queues[c.name]} })"
+            f"(hot objects: {hot})"
         )
 
     def run_forever(self, stop=None, poll_interval_s: float = 1.0,
@@ -184,7 +195,7 @@ class Manager:
         n = self._retries.get(k, 0) + 1
         self._retries[k] = n
         if n <= self.MAX_RETRIES:
-            self._queues[c.name].add(req)
+            self.enqueue(c, req)
         else:
             self.errors.append((c.name, req, e))
 

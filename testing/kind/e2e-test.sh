@@ -22,6 +22,9 @@ testing/kind/fake-tpu-node.sh tpu-v5p-slice 2x2x2 4
 
 echo "=== spawn a multi-host TPU notebook ==="
 kubectl create ns "$NS_USER" --dry-run=client -o yaml | kubectl apply -f -
+# the pod-mutating webhook's namespaceSelector keys on the label the
+# profile controller applies; the e2e namespace is created bare
+kubectl label ns "$NS_USER" app.kubernetes.io/part-of=kubeflow-profile --overwrite
 cat <<EOF | kubectl apply -f -
 apiVersion: kubeflow.org/v1
 kind: Notebook

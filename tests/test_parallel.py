@@ -136,3 +136,42 @@ def test_ring_attention_differentiable(devices8):
     for gr, gd in zip(g_ring, g_dense):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
                                    atol=1e-4, rtol=1e-3)
+
+
+def test_make_hybrid_mesh_cpu_fallback(devices8):
+    """Hybrid multislice mesh on virtual CPU devices (no slice_index):
+    dp spans the slices, per-slice blocks are contiguous, and a sharded
+    computation over the mesh matches single-device numerics."""
+    from kubeflow_rm_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(
+        MeshConfig(dp=2, fsdp=2, sp=1, tp=2), n_slices=2, devices=devices8
+    )
+    assert mesh.shape == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+    # slice-major: the first dp block is exactly the first 4 devices
+    grid = np.asarray(mesh.devices)
+    assert [d.id for d in grid[0].flatten()] == [d.id for d in devices8[:4]]
+    assert [d.id for d in grid[1].flatten()] == [d.id for d in devices8[4:]]
+
+    x = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"), "tp")))
+    out = jax.jit(lambda a: jnp.sum(a * a, axis=-1))(xs)
+    np.testing.assert_allclose(np.asarray(out), (x * x).sum(-1), rtol=1e-6)
+
+
+def test_make_hybrid_mesh_dp_must_match_slices(devices8):
+    from kubeflow_rm_tpu.parallel.mesh import make_hybrid_mesh
+
+    with pytest.raises(ValueError, match="must equal n_slices"):
+        make_hybrid_mesh(
+            MeshConfig(dp=4, fsdp=2, sp=1, tp=1), n_slices=2, devices=devices8
+        )
+
+
+def test_make_hybrid_mesh_dp_wildcard(devices8):
+    from kubeflow_rm_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(
+        MeshConfig(dp=-1, fsdp=4, sp=1, tp=1), n_slices=2, devices=devices8
+    )
+    assert mesh.shape["dp"] == 2

@@ -115,6 +115,10 @@ class RestServer:
         import collections
         self._watch_queues: list[tuple[str, queue.Queue]] = []
         self._backlog: collections.deque = collections.deque(maxlen=2048)
+        # rv horizon of the backlog: anything <= this may have been
+        # evicted, so a watch asking to resume below it gets 410 Gone
+        # (the informer then relists — kubeclient.watch_kind)
+        self._backlog_floor = 0
         self._watch_lock = threading.Lock()
         api.add_watcher(self._on_event)
 
@@ -128,6 +132,8 @@ class RestServer:
         except (TypeError, ValueError):
             rv = 0
         with self._watch_lock:
+            if len(self._backlog) == self._backlog.maxlen:
+                self._backlog_floor = self._backlog[0][0]
             self._backlog.append((rv, obj.get("kind"), evt))
             for kind, q in self._watch_queues:
                 if obj.get("kind") == kind:
@@ -231,8 +237,15 @@ class RestServer:
             since_rv = 0
         with self._watch_lock:
             # replay-then-register atomically vs _on_event: events with
-            # rv > the client's list rv land in q exactly once
-            if since_rv:
+            # rv > the client's list rv land in q exactly once. A
+            # since_rv below the backlog horizon cannot be replayed
+            # faithfully -> 410 Gone ERROR event, client must relist.
+            if since_rv and since_rv < self._backlog_floor:
+                q.put({"type": "ERROR", "object": _status(
+                    410, "Expired",
+                    f"resourceVersion {since_rv} is too old "
+                    f"(horizon {self._backlog_floor})")})
+            elif since_rv:
                 for rv, kind, evt in self._backlog:
                     if kind == route.kind and rv > since_rv:
                         q.put(evt)
@@ -259,6 +272,11 @@ class RestServer:
                     evt = q.get(timeout=min(remaining, 1.0))
                 except queue.Empty:
                     continue
+                if evt.get("type") == "ERROR":
+                    # 410 Gone: report and end the stream; the client
+                    # must relist
+                    write_chunk(json.dumps(evt).encode() + b"\n")
+                    break
                 if route.namespace and (
                         (evt["object"].get("metadata") or {})
                         .get("namespace")) != route.namespace:

@@ -210,6 +210,38 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
     return x
 
 
+def _prologue(params, tokens, cfg: LlamaConfig, positions, segments,
+              packed: bool):
+    """Shared forward prologue: the positions/packed mask contract,
+    embedding gather, rope tables, and the remat-wrapped block. Used by
+    both the plain ``forward`` and ``parallel.pipeline`` so the two
+    execution schedules cannot drift."""
+    B, T = tokens.shape
+    if positions is None or packed:
+        attn_positions = None
+    else:
+        attn_positions = positions
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    # gather the (B, T, D) rows first, then cast — never materialize a
+    # compute-dtype copy of the whole (V, D) table
+    x = params["embed"]["tokens"][tokens].astype(cfg.dtype)
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
+    return x, cos, sin, attn_positions, block
+
+
+def _epilogue(params, x, cfg: LlamaConfig) -> jax.Array:
+    """Shared forward epilogue: final norm, lm head, fp32 logits."""
+    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
 def forward(
     params: dict,
     tokens: jax.Array,
@@ -243,29 +275,11 @@ def forward(
     Returns:
       (B, T, vocab) fp32 logits.
     """
-    B, T = tokens.shape
-    cdt = cfg.dtype
-    if positions is None or packed:
-        attn_positions = None
-    else:
-        attn_positions = positions
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-
-    # gather the (B, T, D) rows first, then cast — never materialize a
-    # compute-dtype copy of the whole (V, D) table
-    x = params["embed"]["tokens"][tokens].astype(cdt)
-    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
-
-    block = partial(_block, cfg)
-    if cfg.remat:
-        block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
+    x, cos, sin, attn_positions, block = _prologue(
+        params, tokens, cfg, positions, segments, packed)
 
     def scan_body(x, layer):
         return block(x, layer, cos, sin, attn_positions, segments), None
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
-
-    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(cdt)
-    return logits.astype(jnp.float32)
+    return _epilogue(params, x, cfg)

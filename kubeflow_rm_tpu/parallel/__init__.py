@@ -1,4 +1,5 @@
-from kubeflow_rm_tpu.parallel.mesh import MeshConfig, make_mesh
+from kubeflow_rm_tpu.parallel.mesh import MeshConfig, make_hybrid_mesh, make_mesh
+from kubeflow_rm_tpu.parallel.pipeline import pipeline_forward
 from kubeflow_rm_tpu.parallel.sharding import (
     batch_pspec,
     param_pspecs,
@@ -17,7 +18,9 @@ from kubeflow_rm_tpu.parallel.zigzag_ring import (
 
 __all__ = [
     "MeshConfig",
+    "make_hybrid_mesh",
     "make_mesh",
+    "pipeline_forward",
     "batch_pspec",
     "param_pspecs",
     "param_shardings",

@@ -4,6 +4,11 @@ Axis convention used throughout the framework:
 
 - ``dp``   pure data parallelism (params replicated) — maps to DCN
            across slices in multi-slice jobs.
+- ``pp``   pipeline parallelism (layer-stack sharded into stages,
+           GPipe microbatch schedule in ``parallel.pipeline``). Its
+           traffic is one point-to-point activation transfer per
+           microbatch — the lowest-bandwidth axis, so it sits just
+           inside dp and can span DCN too.
 - ``fsdp`` data parallelism with parameter sharding (ZeRO-3 style);
            rides ICI within a slice so the per-layer all-gathers are
            cheap.
@@ -27,18 +32,19 @@ import jax
 from jax.sharding import AxisType, Mesh
 
 
-AXES = ("dp", "fsdp", "sp", "tp")
+AXES = ("dp", "pp", "fsdp", "sp", "tp")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1
+    pp: int = 1
     fsdp: int = -1  # -1: absorb all remaining devices
     sp: int = 1
     tp: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        sizes = [self.dp, self.fsdp, self.sp, self.tp]
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        sizes = [self.dp, self.pp, self.fsdp, self.sp, self.tp]
         known = 1
         for s in sizes:
             if s != -1:
@@ -52,7 +58,8 @@ class MeshConfig:
                     f"{n_devices} devices not divisible by fixed axes {known}"
                 )
             sizes[sizes.index(-1)] = n_devices // known
-        if sizes[0] * sizes[1] * sizes[2] * sizes[3] != n_devices:
+        import math
+        if math.prod(sizes) != n_devices:
             raise ValueError(
                 f"mesh {dict(zip(AXES, sizes))} does not cover {n_devices} devices"
             )
@@ -76,8 +83,8 @@ def make_hybrid_mesh(config: MeshConfig | None = None, *,
     config = config or MeshConfig()
     devices = devices if devices is not None else jax.devices()
     if config.dp == -1:
-        config = MeshConfig(dp=n_slices, fsdp=config.fsdp, sp=config.sp,
-                            tp=config.tp)
+        config = MeshConfig(dp=n_slices, pp=config.pp, fsdp=config.fsdp,
+                            sp=config.sp, tp=config.tp)
     shape = config.resolve(len(devices))
     if shape[0] != n_slices:
         raise ValueError(
@@ -86,7 +93,7 @@ def make_hybrid_mesh(config: MeshConfig | None = None, *,
     per_slice = len(devices) // n_slices
     dev_mesh = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=(1, *shape[1:]),
-        dcn_mesh_shape=(n_slices, 1, 1, 1),
+        dcn_mesh_shape=(n_slices,) + (1,) * (len(AXES) - 1),
         devices=devices,
         process_is_granule=False,
         should_sort_granules_by_key=True,

@@ -3,8 +3,10 @@
 The recipe (scaling-book style): annotate shardings on params and batch,
 jit the step, and let XLA's SPMD partitioner insert the collectives.
 
-Rules for the layer-stacked Llama pytree (leading axis = layer, never
-sharded):
+Rules for the layer-stacked Llama pytree (leading axis = layer,
+sharded over ``pp`` into pipeline stages — identity when pp=1; a pp>1
+mesh requires the ``parallel.pipeline`` schedule, a plain jit forward
+would all-gather the stack):
 
 - column-parallel weights (wq/wk/wv/w_gate/w_up): contract dim sharded
   on ``fsdp``, output dim on ``tp`` — forward needs an fsdp all-gather
@@ -25,15 +27,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # path (joined with '/') -> spec for the stacked-layer llama pytree
 _LLAMA_RULES = {
     "embed/tokens": P("tp", "fsdp"),
-    "blocks/attn_norm": P(None, None),
-    "blocks/mlp_norm": P(None, None),
-    "blocks/wq": P(None, "fsdp", "tp"),
-    "blocks/wk": P(None, "fsdp", "tp"),
-    "blocks/wv": P(None, "fsdp", "tp"),
-    "blocks/wo": P(None, "tp", "fsdp"),
-    "blocks/w_gate": P(None, "fsdp", "tp"),
-    "blocks/w_up": P(None, "fsdp", "tp"),
-    "blocks/w_down": P(None, "tp", "fsdp"),
+    "blocks/attn_norm": P("pp", None),
+    "blocks/mlp_norm": P("pp", None),
+    "blocks/wq": P("pp", "fsdp", "tp"),
+    "blocks/wk": P("pp", "fsdp", "tp"),
+    "blocks/wv": P("pp", "fsdp", "tp"),
+    "blocks/wo": P("pp", "tp", "fsdp"),
+    "blocks/w_gate": P("pp", "fsdp", "tp"),
+    "blocks/w_up": P("pp", "fsdp", "tp"),
+    "blocks/w_down": P("pp", "tp", "fsdp"),
     "out_norm": P(None),
     "lm_head": P("fsdp", "tp"),
 }

@@ -76,25 +76,38 @@ def state_shardings(cfg: TrainConfig, state: TrainState, mesh: Mesh) -> TrainSta
     )
 
 
-def loss_fn(params, batch, cfg: TrainConfig):
+def loss_fn(params, batch, cfg: TrainConfig,
+            mesh: Mesh | None = None, n_microbatches: int | None = None):
     # batches come from training.data (pack_documents layout: per-doc
     # restarting positions), so the packed fast path is sound here
-    logits = forward(params, batch["tokens"], cfg.model,
-                     positions=batch.get("positions"),
-                     segments=batch.get("segments"),
-                     packed=batch.get("segments") is not None)
+    kwargs = dict(positions=batch.get("positions"),
+                  segments=batch.get("segments"),
+                  packed=batch.get("segments") is not None)
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        from kubeflow_rm_tpu.parallel.pipeline import pipeline_forward
+        logits = pipeline_forward(params, batch["tokens"], cfg.model, mesh,
+                                  n_microbatches=n_microbatches, **kwargs)
+    else:
+        logits = forward(params, batch["tokens"], cfg.model, **kwargs)
     return softmax_cross_entropy(logits, batch["labels"], z_loss=cfg.z_loss)
 
 
 def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
-                    batch_keys: tuple = ("tokens", "labels")) -> Callable:
+                    batch_keys: tuple = ("tokens", "labels"),
+                    n_microbatches: int | None = None) -> Callable:
     """Return jitted ``step(state, batch) -> (state, metrics)``.
 
     ``batch`` maps each of ``batch_keys`` to a (B, T) int32 array laid
     out with ``batch_pspec`` on ``mesh`` — "tokens" and "labels" always,
     plus "positions" and "segments" when training on packed documents
     (see ``training.data.pack_documents``).
+
+    On a mesh with pp > 1 the forward runs the GPipe schedule
+    (``parallel.pipeline``); ``n_microbatches`` (default: pp) sets the
+    bubble fraction (pp-1)/(n_microbatches+pp-1).
     """
+    if mesh.shape.get("pp", 1) > 1 and n_microbatches is None:
+        n_microbatches = mesh.shape["pp"]
     opt = make_optimizer(cfg.optim)
     sshard = state_shardings(cfg, state, mesh)
     bshard = {k: NamedSharding(mesh, batch_pspec()) for k in batch_keys}
@@ -102,7 +115,7 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, cfg)
+            state.params, batch, cfg, mesh, n_microbatches)
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)

@@ -348,3 +348,65 @@ def test_spawn_call_stack_through_rest_boundary():
         assert mgr.errors == []
     finally:
         rest.stop()
+
+
+def test_watch_replays_gap_events(cluster):
+    """Events landing between a client's LIST and its watch
+    registration are replayed from the rv backlog, not dropped."""
+    import queue as queue_mod
+    import urllib.request
+
+    api, kapi = cluster
+    # simulate the gap: list (captures rv), then a write BEFORE the
+    # watch opens
+    listed = kapi.list("ConfigMap", "u")
+    rv = api._rv
+    api.create(make_object("v1", "ConfigMap", "gap", "u"))
+
+    out: queue_mod.Queue = queue_mod.Queue()
+
+    def read_watch():
+        url = (f"{kapi.base_url}/api/v1/namespaces/u/configmaps"
+               f"?watch=true&resourceVersion={rv}&timeoutSeconds=2")
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    out.put(json.loads(line))
+
+    t = threading.Thread(target=read_watch, daemon=True)
+    t.start()
+    evt = out.get(timeout=5)
+    assert evt["type"] == "ADDED"
+    assert evt["object"]["metadata"]["name"] == "gap"
+
+
+def test_watch_stale_rv_gets_410(cluster):
+    """A resumption rv below the backlog horizon cannot be served
+    faithfully: the stream must emit an ERROR (410 Gone) event so the
+    informer relists instead of silently missing events."""
+    import urllib.request
+
+    api, kapi = cluster
+    # push the backlog past its maxlen so the horizon moves
+    rest_server = None
+    # find the RestServer behind kapi via the backlog attribute
+    # (white-box: force a small horizon rather than generating 2048
+    # events)
+    import gc
+    from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+    for o in gc.get_objects():
+        if isinstance(o, RestServer) and o.api is api:
+            rest_server = o
+            break
+    assert rest_server is not None
+    with rest_server._watch_lock:
+        rest_server._backlog_floor = 10_000
+
+    url = (f"{kapi.base_url}/api/v1/namespaces/u/configmaps"
+           f"?watch=true&resourceVersion=1&timeoutSeconds=2")
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        line = next(iter(resp)).strip()
+    evt = json.loads(line)
+    assert evt["type"] == "ERROR"
+    assert evt["object"]["code"] == 410

@@ -1,7 +1,29 @@
-from kubeflow_rm_tpu.models.llama import (
-    LlamaConfig,
-    init_params,
-    forward,
-)
+"""Model zoo. ``init_params`` / ``forward_with_aux`` dispatch on the
+config type so generic code (training, bench, dryrun) never branches on
+model families itself."""
 
-__all__ = ["LlamaConfig", "init_params", "forward"]
+import jax
+
+from kubeflow_rm_tpu.models import llama as _llama
+from kubeflow_rm_tpu.models import mixtral as _mixtral
+from kubeflow_rm_tpu.models.llama import LlamaConfig, forward
+from kubeflow_rm_tpu.models.mixtral import MixtralConfig
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Family-correct parameter init for any model config."""
+    if isinstance(cfg, MixtralConfig):
+        return _mixtral.init_params(cfg, key)
+    return _llama.init_params(cfg, key)
+
+
+def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
+    """Uniform forward: returns (logits, aux) where aux is the router
+    load-balancing loss for MoE families and None for dense ones."""
+    if isinstance(cfg, MixtralConfig):
+        return _mixtral.forward(params, tokens, cfg, **kwargs)
+    return _llama.forward(params, tokens, cfg, **kwargs), None
+
+
+__all__ = ["LlamaConfig", "MixtralConfig", "init_params", "forward",
+           "forward_with_aux"]

@@ -156,9 +156,12 @@ def param_spec_shapes(cfg: LlamaConfig) -> dict:
     }
 
 
-def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
-    """Random-init a parameter pytree matching ``param_spec_shapes``."""
-    shapes = param_spec_shapes(cfg)
+def init_params(cfg: LlamaConfig, key: jax.Array,
+                shapes: dict | None = None) -> dict:
+    """Random-init a parameter pytree matching ``param_spec_shapes``
+    (or an explicit ``shapes`` tree — the MoE family passes its own)."""
+    if shapes is None:
+        shapes = param_spec_shapes(cfg)
     flat, treedef = jax.tree_util.tree_flatten_with_path(
         shapes, is_leaf=lambda x: isinstance(x, tuple)
     )
@@ -169,7 +172,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
         name = path[-1].key
         if "norm" in name:
             return jnp.ones(shape, cfg.param_dtype)
-        if name in ("wo", "w_down"):  # residual-writing projections
+        if name in ("wo", "w_down", "moe_down"):  # residual-writing projections
             return (jax.random.normal(k, shape) * out_scale).astype(cfg.param_dtype)
         return (jax.random.normal(k, shape) * 0.02).astype(cfg.param_dtype)
 
@@ -177,12 +180,15 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
-    """One transformer block. x: (B, T, D) in compute dtype.
+def _attention_half(cfg: LlamaConfig, x, layer, cos, sin, positions,
+                    segments):
+    """Pre-norm attention + residual. x: (B, T, D) in compute dtype.
 
     Activations are tagged with ``checkpoint_name`` so remat policies
     can save exactly the tensors whose recompute is expensive relative
-    to their HBM cost (see ``LlamaConfig.remat_policy``)."""
+    to their HBM cost (see ``LlamaConfig.remat_policy``). Shared with
+    the MoE family (``models.mixtral``), whose blocks differ only in
+    the FFN half."""
     from jax.ad_checkpoint import checkpoint_name
 
     B, T, D = x.shape
@@ -201,8 +207,15 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
         segment_ids_q=segments, segment_ids_kv=segments,
     )
     attn = checkpoint_name(attn, "attn_out")
-    x = x + attn.reshape(B, T, H * hd) @ layer["wo"].astype(cdt)
+    return x + attn.reshape(B, T, H * hd) @ layer["wo"].astype(cdt)
 
+
+def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
+    """One transformer block (attention + dense SwiGLU MLP)."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    cdt = cfg.dtype
+    x = _attention_half(cfg, x, layer, cos, sin, positions, segments)
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     gate = checkpoint_name(h @ layer["w_gate"].astype(cdt), "mlp_gate")
     up = checkpoint_name(h @ layer["w_up"].astype(cdt), "mlp_up")

@@ -1,0 +1,126 @@
+"""Mixtral-family sparse MoE decoder — the Llama attention stack with
+per-layer mixture-of-experts FFNs (``parallel.moe``).
+
+Same TPU-first structure as ``models.llama`` (scan over stacked layers,
+remat, bf16 compute / fp32 params); the FFN half is the dense-dispatch
+MoE layer, expert-parallel over the ``ep`` mesh axis purely via
+shardings (``sharding._LLAMA_RULES`` moe entries). SURVEY.md §2.6 lists
+EP among the parallelism styles to supply in-image; the reference ships
+it through its torch/NCCL engine, this is the XLA-collective
+re-design.
+
+``forward`` returns ``(logits, aux_loss)`` — the router load-balancing
+loss must be added to the training objective
+(``cfg.moe.router_aux_weight`` scales it; ``training.train.loss_fn``
+does this automatically for MixtralConfig models).
+"""
+
+from dataclasses import dataclass, field, replace
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_rm_tpu.models.llama import (
+    LlamaConfig,
+    _attention_half,
+    _epilogue,
+    _prologue,
+)
+from kubeflow_rm_tpu.models.llama import (
+    init_params as _llama_init,
+)
+from kubeflow_rm_tpu.models.llama import (
+    param_spec_shapes as _llama_shapes,
+)
+from kubeflow_rm_tpu.ops import rms_norm
+from kubeflow_rm_tpu.parallel.moe import MoeConfig, moe_ffn
+
+
+@dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    moe: MoeConfig = field(default_factory=MoeConfig)
+
+    @staticmethod
+    def mixtral_8x7b(**overrides) -> "MixtralConfig":
+        return replace(
+            MixtralConfig(vocab_size=32000, dim=4096, n_layers=32,
+                          n_heads=32, n_kv_heads=8, hidden_dim=14336,
+                          rope_theta=1e6, max_seq_len=32768,
+                          moe=MoeConfig(n_experts=8, top_k=2)),
+            **overrides,
+        )
+
+    @staticmethod
+    def tiny_moe(**overrides) -> "MixtralConfig":
+        base = LlamaConfig.tiny()
+        return replace(
+            MixtralConfig(
+                vocab_size=base.vocab_size, dim=base.dim,
+                n_layers=base.n_layers, n_heads=base.n_heads,
+                n_kv_heads=base.n_kv_heads, hidden_dim=base.hidden_dim,
+                max_seq_len=base.max_seq_len, dtype=base.dtype,
+                moe=MoeConfig(n_experts=4, top_k=2,
+                              capacity_factor=2.0)),
+            **overrides,
+        )
+
+
+def param_spec_shapes(cfg: MixtralConfig) -> dict:
+    """Llama tree with the dense MLP replaced by stacked expert FFNs."""
+    shapes = _llama_shapes(cfg)
+    blocks = dict(shapes["blocks"])
+    for k in ("w_gate", "w_up", "w_down"):
+        del blocks[k]
+    L, D, F, E = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.moe.n_experts
+    blocks["router"] = (L, D, E)
+    blocks["moe_gate"] = (L, E, D, F)
+    blocks["moe_up"] = (L, E, D, F)
+    blocks["moe_down"] = (L, E, F, D)
+    return {**shapes, "blocks": blocks}
+
+
+def init_params(cfg: MixtralConfig, key: jax.Array) -> dict:
+    return _llama_init(cfg, key, shapes=param_spec_shapes(cfg))
+
+
+def _moe_block(cfg: MixtralConfig, x, layer, cos, sin, positions,
+               segments):
+    """Attention half shared with Llama; MoE FFN half. Returns
+    (x, aux_loss)."""
+    x = _attention_half(cfg, x, layer, cos, sin, positions, segments)
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    out, aux = moe_ffn(layer, h, cfg.moe, dtype=cfg.dtype)
+    return x + out, aux
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: MixtralConfig,
+    positions: jax.Array | None = None,
+    segments: jax.Array | None = None,
+    *,
+    packed: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Causal LM forward. Returns ((B, T, vocab) fp32 logits,
+    mean-per-layer router aux loss)."""
+    # the shared prologue's remat-wrapped dense block is unused here;
+    # wrap the moe block with the same policy instead
+    x, cos, sin, attn_positions, _ = _prologue(
+        params, tokens, cfg, positions, segments, packed)
+
+    from functools import partial
+
+    block = partial(_moe_block, cfg)
+    if cfg.remat:
+        from kubeflow_rm_tpu.models.llama import _remat_policy
+        block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
+
+    def scan_body(carry, layer):
+        x, aux_sum = carry
+        x, aux = block(x, layer, cos, sin, attn_positions, segments)
+        return (x, aux_sum + aux), None
+
+    (x, aux_sum), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["blocks"])
+    return _epilogue(params, x, cfg), aux_sum / cfg.n_layers

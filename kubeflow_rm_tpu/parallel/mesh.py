@@ -12,6 +12,9 @@ Axis convention used throughout the framework:
 - ``fsdp`` data parallelism with parameter sharding (ZeRO-3 style);
            rides ICI within a slice so the per-layer all-gathers are
            cheap.
+- ``ep``   expert parallelism (MoE expert dim sharded; the dispatch
+           einsums become XLA all-to-alls over ICI —
+           ``parallel.moe``).
 - ``sp``   sequence/context parallelism (ring attention) — also ICI.
 - ``tp``   tensor (megatron-style) parallelism — innermost axis so its
            per-matmul collectives take the fastest ICI hops.
@@ -32,7 +35,7 @@ import jax
 from jax.sharding import AxisType, Mesh
 
 
-AXES = ("dp", "pp", "fsdp", "sp", "tp")
+AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -40,11 +43,12 @@ class MeshConfig:
     dp: int = 1
     pp: int = 1
     fsdp: int = -1  # -1: absorb all remaining devices
+    ep: int = 1
     sp: int = 1
     tp: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
-        sizes = [self.dp, self.pp, self.fsdp, self.sp, self.tp]
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        sizes = [self.dp, self.pp, self.fsdp, self.ep, self.sp, self.tp]
         known = 1
         for s in sizes:
             if s != -1:
@@ -84,7 +88,7 @@ def make_hybrid_mesh(config: MeshConfig | None = None, *,
     devices = devices if devices is not None else jax.devices()
     if config.dp == -1:
         config = MeshConfig(dp=n_slices, pp=config.pp, fsdp=config.fsdp,
-                            sp=config.sp, tp=config.tp)
+                            ep=config.ep, sp=config.sp, tp=config.tp)
     shape = config.resolve(len(devices))
     if shape[0] != n_slices:
         raise ValueError(

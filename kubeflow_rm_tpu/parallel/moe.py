@@ -1,0 +1,133 @@
+"""Mixture-of-experts layer with expert parallelism over the ``ep`` axis.
+
+TPU-first design — dense dispatch, not gather/scatter:
+
+- Routing produces a static-shaped dispatch tensor (tokens, E, C) and
+  the expert FFN runs as one batched matmul over the expert dim. No
+  ragged shapes, no data-dependent control flow: everything tiles onto
+  the MXU and jit-compiles once (GShard/Switch formulation).
+- Expert parallelism is pure sharding: the expert dim of the weights
+  carries ``ep`` (``sharding._MIXTRAL_RULES``) and XLA's SPMD
+  partitioner turns the dispatch/combine einsums into the all-to-alls
+  an expert-parallel layer needs — the scaling-book recipe, in contrast
+  to the reference's hand-written NCCL all-to-all (SURVEY.md §2.6 lists
+  EP as an in-image capability to supply).
+- Capacity-dropped tokens fall through on the residual path (standard
+  Switch behavior); the auxiliary load-balancing loss keeps routing
+  uniform so drops stay rare.
+"""
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    # per-expert slots = ceil(top_k * tokens * capacity_factor / E)
+    capacity_factor: float = 1.25
+    # weight of the load-balancing aux loss in the training objective
+    router_aux_weight: float = 0.01
+
+
+def expert_capacity(cfg: MoeConfig, n_tokens: int) -> int:
+    import math
+    cap = math.ceil(cfg.top_k * n_tokens * cfg.capacity_factor /
+                    cfg.n_experts)
+    return max(cap, 1)
+
+
+def route(router_logits: jax.Array, cfg: MoeConfig, capacity: int):
+    """Top-k routing with per-expert capacity.
+
+    Args:
+      router_logits: (N, E) fp32.
+    Returns:
+      dispatch: (N, E, C) 0/1 — token n occupies slot c of expert e.
+      combine: (N, E, C) fp32 — dispatch weighted by the (renormalized)
+        top-k gate.
+      aux_loss: scalar load-balancing loss (Switch formulation,
+        ``E * Σ_e fraction_routed_e * mean_prob_e``).
+    """
+    N, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)  # (N, k)
+    gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+    # one-hot per slot; slot 0 (the argmax choice) claims capacity
+    # before slot 1 across ALL tokens, then ties break by token order —
+    # priority is (slot, token), matching the GShard schedule
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (N, k, E)
+    slot_major = onehot.transpose(1, 0, 2).reshape(cfg.top_k * N, E)
+    pos = jnp.cumsum(slot_major, axis=0) - 1  # position within expert
+    pos = pos.reshape(cfg.top_k, N, E).transpose(1, 0, 2)  # (N, k, E)
+    pos = jnp.sum(pos * onehot, axis=-1)  # (N, k) slot index
+    fits = pos < capacity
+
+    slot_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+    slot_onehot = slot_onehot * fits[..., None]
+    # (N, k, E, C): expert choice x slot
+    dispatch_k = onehot[..., None].astype(jnp.float32) * \
+        slot_onehot[:, :, None, :]
+    dispatch = jnp.sum(dispatch_k, axis=1)  # (N, E, C)
+    combine = jnp.sum(
+        dispatch_k * gate_vals[..., None, None], axis=1)
+
+    # load balance: fraction of tokens whose TOP choice is e x mean
+    # router prob on e (differentiable through probs)
+    top1 = onehot[:, 0, :].astype(jnp.float32)
+    frac_routed = jnp.mean(top1, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(frac_routed * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+def moe_param_shapes(cfg: MoeConfig, dim: int, hidden: int) -> dict:
+    E = cfg.n_experts
+    return {
+        "router": (dim, E),
+        "moe_gate": (E, dim, hidden),
+        "moe_up": (E, dim, hidden),
+        "moe_down": (E, hidden, dim),
+    }
+
+
+def moe_ffn(params: dict, x: jax.Array, cfg: MoeConfig,
+            dtype: Any = jnp.bfloat16):
+    """SwiGLU expert FFN. x: (B, T, D) -> ((B, T, D), aux_loss).
+
+    The (E, C, D) expert batch is where EP bites: with w_* sharded
+    P(..., "ep", ...) the dispatch einsum becomes an all-to-all and the
+    three expert matmuls run ep-parallel.
+    """
+    B, T, D = x.shape
+    N = B * T
+    xf = x.reshape(N, D)
+    # router in fp32: tiny matmul, and routing decisions should not
+    # flip with bf16 rounding
+    logits = xf.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    capacity = expert_capacity(cfg, N)
+    dispatch, combine, aux = route(logits, cfg, capacity)
+
+    from jax.ad_checkpoint import checkpoint_name
+
+    xc = xf.astype(dtype)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype), xc)
+    # tag with the same names as the dense MLP so the named remat
+    # policies ("mlp", "attn+mlp") buy the same HBM/recompute trade
+    # for the expert FFN
+    gate = checkpoint_name(
+        jnp.einsum("ecd,edf->ecf", expert_in,
+                   params["moe_gate"].astype(dtype)), "mlp_gate")
+    up = checkpoint_name(
+        jnp.einsum("ecd,edf->ecf", expert_in,
+                   params["moe_up"].astype(dtype)), "mlp_up")
+    h = jax.nn.silu(gate) * up
+    expert_out = jnp.einsum("ecf,efd->ecd", h,
+                            params["moe_down"].astype(dtype))
+    out = jnp.einsum("nec,ecd->nd", combine.astype(dtype), expert_out)
+    return out.reshape(B, T, D), aux

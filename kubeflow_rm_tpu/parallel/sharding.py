@@ -38,6 +38,12 @@ _LLAMA_RULES = {
     "blocks/w_down": P("pp", "tp", "fsdp"),
     "out_norm": P(None),
     "lm_head": P("fsdp", "tp"),
+    # MoE (mixtral family): expert dim on ep — the dispatch/combine
+    # einsums become all-to-alls, the expert matmuls run ep-parallel
+    "blocks/router": P("pp", "fsdp", "ep"),
+    "blocks/moe_gate": P("pp", "ep", "fsdp", "tp"),
+    "blocks/moe_up": P("pp", "ep", "fsdp", "tp"),
+    "blocks/moe_down": P("pp", "ep", "tp", "fsdp"),
 }
 
 

@@ -19,7 +19,12 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_rm_tpu.models.llama import LlamaConfig, forward, init_params
+from kubeflow_rm_tpu.models import (
+    LlamaConfig,
+    MixtralConfig,
+    forward_with_aux,
+    init_params,
+)
 from kubeflow_rm_tpu.ops.losses import softmax_cross_entropy
 from kubeflow_rm_tpu.parallel.sharding import batch_pspec, param_shardings
 from kubeflow_rm_tpu.training.optim import OptimConfig, make_optimizer
@@ -84,12 +89,26 @@ def loss_fn(params, batch, cfg: TrainConfig,
                   segments=batch.get("segments"),
                   packed=batch.get("segments") is not None)
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        if isinstance(cfg.model, MixtralConfig):
+            # a plain forward on a pp>1 mesh would all-gather the
+            # pp-sharded layer stack every step — refuse rather than
+            # silently degrade
+            raise NotImplementedError(
+                "MoE models have no pipeline schedule yet; use a pp=1 "
+                "mesh for MixtralConfig")
         from kubeflow_rm_tpu.parallel.pipeline import pipeline_forward
         logits = pipeline_forward(params, batch["tokens"], cfg.model, mesh,
                                   n_microbatches=n_microbatches, **kwargs)
+        router_aux = None
     else:
-        logits = forward(params, batch["tokens"], cfg.model, **kwargs)
-    return softmax_cross_entropy(logits, batch["labels"], z_loss=cfg.z_loss)
+        logits, router_aux = forward_with_aux(params, batch["tokens"],
+                                              cfg.model, **kwargs)
+    loss, aux = softmax_cross_entropy(logits, batch["labels"],
+                                      z_loss=cfg.z_loss)
+    if router_aux is not None:
+        aux = dict(aux, router_aux=router_aux)
+        loss = loss + cfg.model.moe.router_aux_weight * router_aux
+    return loss, aux
 
 
 def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
@@ -119,8 +138,7 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
-        metrics = {"loss": loss, "nll": aux["nll"], "grad_norm": gnorm,
-                   "n_valid": aux["n_valid"]}
+        metrics = {"loss": loss, "grad_norm": gnorm, **aux}
         return TrainState(step=state.step + 1, params=params,
                           opt_state=opt_state), metrics
 

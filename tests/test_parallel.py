@@ -17,12 +17,12 @@ from kubeflow_rm_tpu.parallel.ring_attention import ring_self_attention
 
 
 def test_mesh_config_resolution(devices8):
-    assert MeshConfig(dp=2, fsdp=2, sp=1, tp=2).resolve(8) == (2, 1, 2, 1, 2)
-    assert MeshConfig(dp=1, fsdp=-1, sp=1, tp=2).resolve(8) == (1, 1, 4, 1, 2)
+    assert MeshConfig(dp=2, fsdp=2, sp=1, tp=2).resolve(8) == (2, 1, 2, 1, 1, 2)
+    assert MeshConfig(dp=1, fsdp=-1, sp=1, tp=2).resolve(8) == (1, 1, 4, 1, 1, 2)
     with pytest.raises(ValueError):
         MeshConfig(dp=3, fsdp=1, sp=1, tp=1).resolve(8)
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2), devices8)
-    assert mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "sp": 1, "tp": 2}
+    assert mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
 
 
 def test_param_pspecs_cover_llama_tree():
@@ -147,7 +147,7 @@ def test_make_hybrid_mesh_cpu_fallback(devices8):
     mesh = make_hybrid_mesh(
         MeshConfig(dp=2, fsdp=2, sp=1, tp=2), n_slices=2, devices=devices8
     )
-    assert mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "sp": 1, "tp": 2}
+    assert mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
     # slice-major: the first dp block is exactly the first 4 devices
     grid = np.asarray(mesh.devices)
     assert [d.id for d in grid[0].flatten()] == [d.id for d in devices8[:4]]

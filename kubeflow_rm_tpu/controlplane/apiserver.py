@@ -77,6 +77,10 @@ class APIServer:
         self._watchers: list[Callable[[str, dict, dict | None], None]] = []
         self._event_seq = 0
         self.quota_enforcement = True
+        # container stdout per pod (the kubelet's log store; the fake
+        # kubelet appends boot lines, the `pods/<name>/log` subresource
+        # reads them — ref jupyter backend get_pod_logs)
+        self._pod_logs: dict[tuple[str, str], list[str]] = {}
 
     # ---- wiring ------------------------------------------------------
     def register_admission(self, kind_pattern: str, fn: Callable) -> None:
@@ -242,8 +246,27 @@ class APIServer:
             return
         self._finalize_delete(key)
 
+    def append_pod_log(self, namespace: str, pod_name: str,
+                       line: str) -> None:
+        self._pod_logs.setdefault((namespace, pod_name), []).append(line)
+
+    def pod_logs(self, namespace: str, pod_name: str,
+                 tail_lines: int | None = None) -> str:
+        """Stored container stdout for a pod (kube ``pods/.../log``).
+        Raises NotFound for a pod that does not exist."""
+        self.get("Pod", pod_name, namespace)
+        lines = self._pod_logs.get((namespace, pod_name), [])
+        if tail_lines is not None:
+            if tail_lines < 0:
+                raise Invalid(f"tailLines must be >= 0, got {tail_lines}")
+            lines = lines[-tail_lines:] if tail_lines else []
+        return "".join(f"{line}\n" for line in lines)
+
     def _finalize_delete(self, key) -> dict:
         obj = self._store.pop(key)
+        if obj["kind"] == "Pod":
+            self._pod_logs.pop(
+                (namespace_of(obj) or "default", name_of(obj)), None)
         self._emit("DELETED", obj)
         self._garbage_collect(obj)
         if obj["kind"] == "Namespace":

@@ -54,6 +54,14 @@ def headless_name(notebook_name: str) -> str:
 class NotebookController(Controller):
     kind = nb_api.KIND
 
+    def __init__(self, use_istio: bool = True,
+                 istio_gateway: str = "kubeflow/kubeflow-gateway"):
+        # the reference gates VirtualService rendering on USE_ISTIO
+        # (notebook_controller.go:519-533); here it is constructor
+        # config like every other knob
+        self.use_istio = use_istio
+        self.istio_gateway = istio_gateway
+
     def watches(self):
         return (
             ("StatefulSet", map_to_owner(nb_api.KIND)),
@@ -81,6 +89,11 @@ class NotebookController(Controller):
 
         for svc in self._generate_services(notebook, topo):
             reconcile_child(api, notebook, svc, copy_service_fields)
+
+        if self.use_istio:
+            reconcile_child(api, notebook,
+                            self._generate_virtualservice(notebook),
+                            _copy_virtualservice_fields)
 
         self._mirror_status(api, notebook, topo)
         self._reemit_pod_events(api, notebook)
@@ -183,6 +196,48 @@ class NotebookController(Controller):
         }
         return [ui, workers]
 
+    def _generate_virtualservice(self, notebook: dict) -> dict:
+        """Gateway route for the notebook UI (ref
+        ``notebook_controller.go:519-619`` ``generateVirtualService``):
+        prefix-match ``/notebook/<ns>/<name>/``, rewrite to the
+        annotation's URI (default "/"), optional request headers from
+        the headers annotation, destination = the worker-0 UI Service."""
+        import json as _json
+
+        name = name_of(notebook)
+        ns = notebook["metadata"]["namespace"]
+        ann = annotations_of(notebook)
+        prefix = f"/notebook/{ns}/{name}/"
+        rewrite = ann.get(nb_api.REWRITE_URI_ANNOTATION) or "/"
+        http_route: dict = {
+            "match": [{"uri": {"prefix": prefix}}],
+            "rewrite": {"uri": rewrite},
+            "route": [{"destination": {
+                "host": f"{name}.{ns}.svc.cluster.local",
+                "port": {"number": SERVICE_PORT},
+            }}],
+            "timeout": "300s",
+        }
+        raw_headers = ann.get(nb_api.HEADERS_ANNOTATION)
+        if raw_headers:
+            try:
+                headers = _json.loads(raw_headers)
+                if isinstance(headers, dict):
+                    http_route["headers"] = {"request": {"set": headers}}
+            except ValueError:
+                pass  # malformed annotation: route without headers, as ref
+        return {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {"name": f"notebook-{ns}-{name}", "namespace": ns,
+                         "labels": {nb_api.NOTEBOOK_NAME_LABEL: name}},
+            "spec": {
+                "hosts": ["*"],
+                "gateways": [self.istio_gateway],
+                "http": [http_route],
+            },
+        }
+
     # -- status --------------------------------------------------------
     def _mirror_status(self, api: APIServer, notebook: dict,
                        topo: tpu_api.SliceTopology | None) -> None:
@@ -246,6 +301,19 @@ def _map_event_to_notebook(event_obj: dict):
         base = inv["name"].rsplit("-", 1)[0]
         return [Request(inv.get("namespace"), base)]
     return []
+
+
+def _copy_virtualservice_fields(desired: dict, found: dict) -> bool:
+    changed = False
+    for field in ("labels", "annotations"):
+        want = desired["metadata"].get(field) or {}
+        if (found["metadata"].get(field) or {}) != want:
+            found["metadata"][field] = dict(want)
+            changed = True
+    if found.get("spec") != desired.get("spec"):
+        found["spec"] = copy.deepcopy(desired["spec"])
+        changed = True
+    return changed
 
 
 def _upsert_env(env: list, name: str, value: str) -> None:

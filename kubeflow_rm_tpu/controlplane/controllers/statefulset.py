@@ -271,6 +271,23 @@ class StatefulSetController(Controller):
             ],
         }
         api.update_status(pod)
+        # synthesize the container boot transcript (what `kubectl logs`
+        # would show): per-ordinal debugging of a multi-host slice is a
+        # first-class JWA feature here
+        env = {e.get("name"): e.get("value")
+               for c in containers for e in (c.get("env") or [])}
+        ns, name = namespace_of(pod), name_of(pod)
+        now = api.clock().isoformat()
+        for c in containers:
+            api.append_pod_log(
+                ns, name, f"{now} pulled image {c.get('image')}")
+        api.append_pod_log(ns, name, f"{now} s6: services started")
+        if "TPU_WORKER_ID" in env:
+            api.append_pod_log(
+                ns, name,
+                f"{now} worker-agent: TPU_WORKER_ID={env['TPU_WORKER_ID']} "
+                f"hostnames={env.get('TPU_WORKER_HOSTNAMES', '')} "
+                "joining jax.distributed")
 
     def _pick_node(self, pod: dict, nodes: list[dict],
                    used: dict[str, float]):

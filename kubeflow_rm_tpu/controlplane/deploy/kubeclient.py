@@ -293,6 +293,18 @@ class KubeAPIServer:
             == involved["kind"]
         ]
 
+    def pod_logs(self, namespace: str, pod_name: str,
+                 tail_lines: int | None = None) -> str:
+        """``GET .../pods/<name>/log`` (the verb behind `kubectl logs`)."""
+        params = {}
+        if tail_lines is not None:
+            params["tailLines"] = str(tail_lines)
+        resp = self._session.get(
+            self._object_url("Pod", pod_name, namespace) + "/log",
+            params=params)
+        self._raise_for(resp, f"logs {namespace}/{pod_name}")
+        return resp.text
+
     # ---- SubjectAccessReview -----------------------------------------
     def access_review(self, user: str | None, verb: str, resource: str,
                       namespace: str | None = None) -> bool:

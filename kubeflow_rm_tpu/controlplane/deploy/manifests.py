@@ -252,7 +252,38 @@ def webapp_objects() -> list[dict]:
             ("kfam", "kfam", 8081),
             ("dashboard", "dashboard", 8082)):
         objs.extend(_webapp_pair(name, cmd, port))
+        objs.append(_webapp_virtualservice(name, port))
     return objs
+
+
+def _webapp_virtualservice(name: str, port: int) -> dict:
+    """Path-route each web app behind the gateway the way the reference
+    dashboard proxies them (``centraldashboard/app/server.ts:56-91``):
+    /jupyter → JWA, /volumes → VWA, ... and / → the dashboard itself."""
+    prefix = {"jupyter-web-app": "/jupyter/",
+              "volumes-web-app": "/volumes/",
+              "tensorboards-web-app": "/tensorboards/",
+              "kfam": "/kfam/",
+              "dashboard": "/"}[name]
+    route = {
+        "match": [{"uri": {"prefix": prefix}}],
+        "route": [{"destination": {
+            "host": f"{name}.kubeflow.svc.cluster.local",
+            "port": {"number": port},
+        }}],
+    }
+    if prefix != "/":
+        route["rewrite"] = {"uri": "/"}
+    return {
+        "apiVersion": "networking.istio.io/v1beta1",
+        "kind": "VirtualService",
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {
+            "hosts": ["*"],
+            "gateways": ["kubeflow/kubeflow-gateway"],
+            "http": [route],
+        },
+    }
 
 
 def _kustomization(resources: list[str], *, namespace: str | None = None,

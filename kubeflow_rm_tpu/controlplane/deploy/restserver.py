@@ -198,6 +198,17 @@ class RestServer:
                 "metadata": {"resourceVersion": str(api._rv)},
                 "items": items,
             })
+        elif method == "GET" and route.subresource == "log" \
+                and kind == "Pod":
+            tail = params.get("tailLines", [None])[0]
+            try:
+                tail_n = int(tail) if tail is not None else None
+            except ValueError:
+                raise Invalid(f"tailLines must be an integer, got {tail!r}")
+            text = api.pod_logs(route.namespace, route.name,
+                                tail_lines=tail_n)
+            self._send_raw(handler, 200, text.encode(),
+                           content_type="text/plain")
         elif method == "GET":
             self._send(handler, 200,
                        api.get(kind, route.name, route.namespace))

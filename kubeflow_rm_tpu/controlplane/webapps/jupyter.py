@@ -291,6 +291,41 @@ def create_app(api: APIServer, *, config_path: str | None = None,
         nb = api.get(nb_api.KIND, name, namespace)
         return {"events": api.events_for(nb)}
 
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>/pods")
+    def get_notebook_pods(req, namespace, name):
+        """Per-host view of the slice: one pod per ordinal, with phase
+        — the ref lists a single server pod
+        (jupyter/backend/apps/common/routes/get.py); a TPU slice has
+        `hosts` of them."""
+        app.ensure_authorized(req, "get", "notebooks", namespace)
+        nb = api.get(nb_api.KIND, name, namespace)
+        pods = sorted(
+            (p for p in api.list("Pod", namespace)
+             if (p["metadata"].get("labels") or {}).get(
+                 nb_api.NOTEBOOK_NAME_LABEL) == name),
+            key=lambda p: p["metadata"]["name"])
+        return {"pods": [
+            {"name": p["metadata"]["name"],
+             "phase": deep_get(p, "status", "phase"),
+             "nodeName": deep_get(p, "spec", "nodeName")}
+            for p in pods]}
+
+    @app.route(
+        "/api/namespaces/<namespace>/notebooks/<name>/pods/<ordinal>/logs")
+    def get_notebook_pod_logs(req, namespace, name, ordinal):
+        """Container logs for one slice host (pod ordinal) — the
+        debugging surface for a hung multi-host rendezvous. Ref:
+        jupyter/backend/apps/common/routes/get.py `get_pod_logs`."""
+        app.ensure_authorized(req, "get", "notebooks", namespace)
+        api.get(nb_api.KIND, name, namespace)  # 404 on unknown notebook
+        try:
+            tail = int(req.args.get("tailLines", "0")) or None
+        except ValueError:
+            tail = None
+        text = api.pod_logs(namespace, f"{name}-{ordinal}",
+                            tail_lines=tail)
+        return {"logs": text.splitlines()}
+
     @app.route("/api/namespaces/<namespace>/notebooks", methods=("POST",))
     def post_notebook(req, namespace):
         app.ensure_authorized(req, "create", "notebooks", namespace)

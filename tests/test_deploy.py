@@ -410,3 +410,20 @@ def test_watch_stale_rv_gets_410(cluster):
     evt = json.loads(line)
     assert evt["type"] == "ERROR"
     assert evt["object"]["code"] == 410
+
+
+def test_kubeclient_pod_logs(cluster):
+    api, kapi = cluster
+    pod = make_object("v1", "Pod", "p-0", "u")
+    pod["spec"] = {"containers": [{"name": "c", "image": "i"}]}
+    kapi.create(pod)
+    api.append_pod_log("u", "p-0", "line one")
+    api.append_pod_log("u", "p-0", "line two")
+    assert kapi.pod_logs("u", "p-0") == "line one\nline two\n"
+    assert kapi.pod_logs("u", "p-0", tail_lines=1) == "line two\n"
+    # kube tailLines semantics: 0 -> nothing, negative/garbage -> 4xx
+    assert kapi.pod_logs("u", "p-0", tail_lines=0) == ""
+    with pytest.raises(Exception, match="tailLines"):
+        kapi.pod_logs("u", "p-0", tail_lines=-1)
+    with pytest.raises(NotFound):
+        kapi.pod_logs("u", "nope")

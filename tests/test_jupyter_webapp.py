@@ -207,3 +207,40 @@ def test_status_ladder_shows_waiting_then_warning(stack):
     entry = json.loads(client.get(
         "/api/namespaces/team/notebooks").get_data())["notebooks"][0]
     assert entry["status"]["phase"] == "warning"
+
+
+def test_pods_and_per_ordinal_logs(stack, app):
+    """Per-host slice debugging: list pods, then fetch one ordinal's
+    container logs (ref jupyter backend get_pod_logs — per-ordinal here
+    because a TPU slice runs `hosts` pods)."""
+    api, mgr = stack
+    client = app.test_client(user=USER)
+    resp = post_json(client, "/api/namespaces/team/notebooks", spawn_body())
+    assert resp.status_code == 200
+    mgr.run_until_idle()
+
+    pods = json.loads(client.get(
+        "/api/namespaces/team/notebooks/mynb/pods").get_data())["pods"]
+    assert [p["name"] for p in pods] == ["mynb-0", "mynb-1"]
+    assert all(p["phase"] == "Running" for p in pods)
+
+    logs = json.loads(client.get(
+        "/api/namespaces/team/notebooks/mynb/pods/1/logs").get_data())
+    joined = "\n".join(logs["logs"])
+    assert "TPU_WORKER_ID=1" in joined
+    assert "joining jax.distributed" in joined
+
+    # tail
+    tail = json.loads(client.get(
+        "/api/namespaces/team/notebooks/mynb/pods/1/logs?tailLines=1"
+    ).get_data())
+    assert len(tail["logs"]) == 1
+
+    # unknown ordinal -> 404
+    resp = client.get("/api/namespaces/team/notebooks/mynb/pods/9/logs")
+    assert resp.status_code == 404
+
+    # authz enforced
+    resp = app.test_client(user="mallory@corp.com").get(
+        "/api/namespaces/team/notebooks/mynb/pods/0/logs")
+    assert resp.status_code == 403

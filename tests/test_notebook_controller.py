@@ -275,3 +275,41 @@ def test_notebook_delete_garbage_collects_children(stack):
     assert api.try_get("Service", "gone", "user1") is None
     assert api.try_get("Service", "gone-workers", "user1") is None
     assert api.list("Pod", "user1") == []
+
+
+def test_virtualservice_rendered_with_rewrite_and_headers(stack):
+    """Istio routing (ref notebook_controller.go:519-619): per-notebook
+    VirtualService behind the kubeflow gateway, honoring the rewrite
+    and request-headers annotations."""
+    import json as _json
+
+    api, mgr = stack
+    nb = make_notebook("nb", "user1", accelerator_type="v5p-16")
+    nb["metadata"]["annotations"] = {
+        nb_api.REWRITE_URI_ANNOTATION: "/custom",
+        nb_api.HEADERS_ANNOTATION: _json.dumps(
+            {"X-RStudio-Root-Path": "/notebook/user1/nb/"}),
+    }
+    api.create(nb)
+    mgr.run_until_idle()
+
+    vs = api.get("VirtualService", "notebook-user1-nb", "user1")
+    (route,) = vs["spec"]["http"]
+    assert route["match"] == [{"uri": {"prefix": "/notebook/user1/nb/"}}]
+    assert route["rewrite"] == {"uri": "/custom"}
+    assert route["headers"]["request"]["set"][
+        "X-RStudio-Root-Path"] == "/notebook/user1/nb/"
+    assert route["route"][0]["destination"]["host"] == \
+        "nb.user1.svc.cluster.local"
+    assert vs["spec"]["gateways"] == ["kubeflow/kubeflow-gateway"]
+    # owned: deleted with the notebook
+    assert any(r.get("controller") for r in
+               vs["metadata"].get("ownerReferences", []))
+
+
+def test_virtualservice_defaults_rewrite_to_root(stack):
+    api, mgr = stack
+    api.create(make_notebook("nb2", "user1"))
+    mgr.run_until_idle()
+    vs = api.get("VirtualService", "notebook-user1-nb2", "user1")
+    assert vs["spec"]["http"][0]["rewrite"] == {"uri": "/"}

@@ -136,6 +136,20 @@ def cmd_manifests(outdir: str | None = None) -> int:
     return 0
 
 
+def cmd_gateway() -> int:
+    """All web apps + the SPA on one origin — the dev/e2e stand-in for
+    the in-cluster gateway (VirtualService path routes). DEV_USER
+    stamps the identity header the mesh auth proxy would."""
+    from kubeflow_rm_tpu.controlplane.webapps.gateway import make_gateway
+    app = make_gateway(
+        _kube_api(),
+        dev_user=os.environ.get("DEV_USER"),
+        secure_cookies=_env_flag("SECURE_COOKIES", True),
+    )
+    _serve_wsgi(app, 8082)
+    return 0
+
+
 COMMANDS = {
     "controller-manager": cmd_controller_manager,
     "webhook-server": cmd_webhook_server,
@@ -144,6 +158,7 @@ COMMANDS = {
     "tensorboards-web-app": lambda: _webapp("tensorboards", 5002),
     "kfam": lambda: _webapp("kfam", 8081),
     "dashboard": lambda: _webapp("dashboard", 8082),
+    "gateway": cmd_gateway,
     "crds": cmd_crds,
 }
 

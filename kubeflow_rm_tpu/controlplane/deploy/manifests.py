@@ -75,13 +75,27 @@ def _service(name: str, port: int, target: int | None = None) -> dict:
     }
 
 
+# gateway route prefix per app — the SAME path the SPA fetches and the
+# VirtualService matches; each app serves its routes under this prefix
+# in-cluster (APP_PREFIX) so the VS forwards without rewriting. The
+# dashboard owns the root (SPA shell + /static + /api).
+ROUTE_PREFIXES = {
+    "jupyter-web-app": "/jupyter",
+    "volumes-web-app": "/volumes",
+    "tensorboards-web-app": "/tensorboards",
+    "kfam": "/kfam",
+    "dashboard": "",
+}
+
+
 def _webapp_pair(name: str, cmd: str, port: int) -> list[dict]:
+    prefix = ROUTE_PREFIXES[name]
     return [
         _deployment(name, ["python", "-m",
                            "kubeflow_rm_tpu.controlplane", cmd],
-                    port=port, probe_path="/healthz",
+                    port=port, probe_path=f"{prefix}/healthz",
                     env=[{"name": "PORT", "value": str(port)},
-                         {"name": "APP_PREFIX", "value": f"/{cmd}"}]),
+                         {"name": "APP_PREFIX", "value": prefix}]),
         _service(name, 80, port),
     ]
 
@@ -259,21 +273,12 @@ def webapp_objects() -> list[dict]:
 def _webapp_virtualservice(name: str, port: int) -> dict:
     """Path-route each web app behind the gateway the way the reference
     dashboard proxies them (``centraldashboard/app/server.ts:56-91``):
-    /jupyter → JWA, /volumes → VWA, ... and / → the dashboard itself."""
-    prefix = {"jupyter-web-app": "/jupyter/",
-              "volumes-web-app": "/volumes/",
-              "tensorboards-web-app": "/tensorboards/",
-              "kfam": "/kfam/",
-              "dashboard": "/"}[name]
-    route = {
-        "match": [{"uri": {"prefix": prefix}}],
-        "route": [{"destination": {
-            "host": f"{name}.kubeflow.svc.cluster.local",
-            "port": {"number": port},
-        }}],
-    }
-    if prefix != "/":
-        route["rewrite"] = {"uri": "/"}
+    /jupyter → JWA, /volumes → VWA, ... and / → the dashboard itself.
+    No rewrite: each app serves its routes under its own prefix
+    (APP_PREFIX in ``_webapp_pair``), and the destination port is the
+    SERVICE port (Istio resolves VS destinations against Service ports,
+    not container ports)."""
+    prefix = ROUTE_PREFIXES[name] + "/"
     return {
         "apiVersion": "networking.istio.io/v1beta1",
         "kind": "VirtualService",
@@ -281,7 +286,13 @@ def _webapp_virtualservice(name: str, port: int) -> dict:
         "spec": {
             "hosts": ["*"],
             "gateways": ["kubeflow/kubeflow-gateway"],
-            "http": [route],
+            "http": [{
+                "match": [{"uri": {"prefix": prefix}}],
+                "route": [{"destination": {
+                    "host": f"{name}.kubeflow.svc.cluster.local",
+                    "port": {"number": 80},
+                }}],
+            }],
         },
     }
 

@@ -1,0 +1,58 @@
+"""Single-origin gateway over every web app — the role the Istio
+gateway + VirtualService path routes play in-cluster
+(``deploy/manifests._webapp_virtualservice``) and the reference
+dashboard's Express proxy plays in dev
+(``centraldashboard/app/server.ts:56-91``).
+
+``make_gateway`` mounts:
+
+    /                     central dashboard API + SPA shell + static
+    /jupyter/...          jupyter web app (spawner)
+    /volumes/...          volumes web app
+    /tensorboards/...     tensorboards web app
+    /kfam/...             access management
+
+Used by the ``dashboard`` process entrypoint, the wallclock conformance
+stack, and browser e2e runs. ``dev_user`` plays the mesh auth proxy:
+it stamps the trusted identity header on every request, which is how a
+browser (that has no Istio sidecar in front of it) gets an identity in
+dev/e2e — NEVER set it behind a real proxy.
+"""
+
+from __future__ import annotations
+
+from werkzeug.middleware.dispatcher import DispatcherMiddleware
+
+from kubeflow_rm_tpu.controlplane.webapps import (
+    dashboard as dashboard_mod,
+    jupyter as jupyter_mod,
+    kfam as kfam_mod,
+    tensorboards as tensorboards_mod,
+    volumes as volumes_mod,
+)
+from kubeflow_rm_tpu.controlplane.webapps.core import USER_HEADER, USER_PREFIX
+
+
+def make_gateway(api, *, dev_user: str | None = None,
+                 secure_cookies: bool = True):
+    """One WSGI app path-routing every web app off a shared backend."""
+    kw = dict(secure_cookies=secure_cookies)
+    gw = DispatcherMiddleware(
+        dashboard_mod.create_app(api, **kw),
+        {
+            "/jupyter": jupyter_mod.create_app(api, **kw),
+            "/volumes": volumes_mod.create_app(api, **kw),
+            "/tensorboards": tensorboards_mod.create_app(api, **kw),
+            "/kfam": kfam_mod.create_app(api, **kw),
+        },
+    )
+    if dev_user is None:
+        return gw
+
+    header_key = "HTTP_" + USER_HEADER.upper().replace("-", "_")
+
+    def with_identity(environ, start_response):
+        environ.setdefault(header_key, USER_PREFIX + dev_user)
+        return gw(environ, start_response)
+
+    return with_identity

@@ -231,8 +231,8 @@ def _materialize_volume(api: APIServer, ns: str, nb: dict,
 # --- the app ----------------------------------------------------------
 
 def create_app(api: APIServer, *, config_path: str | None = None,
-               disable_auth: bool = False, prefix: str = "") -> WebApp:
-    app = WebApp("jupyter", api, prefix=prefix, disable_auth=disable_auth)
+               disable_auth: bool = False, prefix: str = "", **app_kwargs) -> WebApp:
+    app = WebApp("jupyter", api, prefix=prefix, disable_auth=disable_auth, **app_kwargs)
     defaults = load_spawner_config(config_path)
 
     @app.route("/api/config")
@@ -318,10 +318,12 @@ def create_app(api: APIServer, *, config_path: str | None = None,
         jupyter/backend/apps/common/routes/get.py `get_pod_logs`."""
         app.ensure_authorized(req, "get", "notebooks", namespace)
         api.get(nb_api.KIND, name, namespace)  # 404 on unknown notebook
+        raw = req.args.get("tailLines")
         try:
-            tail = int(req.args.get("tailLines", "0")) or None
+            tail = int(raw) if raw is not None else None
         except ValueError:
-            tail = None
+            raise BadRequest(f"tailLines must be an integer, got {raw!r}")
+        # kube semantics delegated to pod_logs: 0 -> nothing, <0 -> 4xx
         text = api.pod_logs(namespace, f"{name}-{ordinal}",
                             tail_lines=tail)
         return {"logs": text.splitlines()}

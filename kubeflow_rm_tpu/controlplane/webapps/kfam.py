@@ -43,8 +43,8 @@ def binding_name(user: str, role: str) -> str:
 
 
 def create_app(api: APIServer, *, disable_auth: bool = False,
-               prefix: str = "") -> WebApp:
-    app = WebApp("kfam", api, prefix=prefix, disable_auth=disable_auth)
+               prefix: str = "", **app_kwargs) -> WebApp:
+    app = WebApp("kfam", api, prefix=prefix, disable_auth=disable_auth, **app_kwargs)
 
     @app.route("/kfam/v1/bindings")
     def get_bindings(req):

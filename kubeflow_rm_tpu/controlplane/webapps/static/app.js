@@ -1,0 +1,655 @@
+/* Dashboard SPA: hash-routed vanilla ES module.
+ *
+ * Views consume the web-app backends exactly where the gateway mounts
+ * them (manifests path routing / webapps.gateway):
+ *   /api/...              central dashboard
+ *   /jupyter/api/...      jupyter web app (spawner)
+ *   /volumes/api/...      volumes web app
+ *   /tensorboards/api/... tensorboards web app
+ *   /kfam/kfam/v1/...     access management
+ * CSRF: double-submit — echo the XSRF-TOKEN cookie in X-XSRF-TOKEN on
+ * unsafe methods (crud_backend contract, webapps/core.py).
+ */
+
+const $ = (sel, el = document) => el.querySelector(sel);
+const view = $("#view");
+
+// ---- api client ------------------------------------------------------
+
+function csrfToken() {
+  const m = document.cookie.match(/(?:^|;\s*)XSRF-TOKEN=([^;]+)/);
+  return m ? decodeURIComponent(m[1]) : "";
+}
+
+async function api(method, url, body) {
+  const opts = { method, headers: {} };
+  if (!["GET", "HEAD"].includes(method)) {
+    opts.headers["X-XSRF-TOKEN"] = csrfToken();
+  }
+  if (body !== undefined) {
+    opts.headers["Content-Type"] = "application/json";
+    opts.body = JSON.stringify(body);
+  }
+  const resp = await fetch(url, opts);
+  let data = {};
+  try { data = await resp.json(); } catch { /* non-JSON error body */ }
+  if (!resp.ok || data.success === false) {
+    throw new Error(data.log || `${method} ${url}: HTTP ${resp.status}`);
+  }
+  return data;
+}
+
+const get = (url) => api("GET", url);
+const post = (url, body) => api("POST", url, body);
+const patch = (url, body) => api("PATCH", url, body);
+const del = (url) => api("DELETE", url);
+
+// ---- shared state ----------------------------------------------------
+
+const state = {
+  namespace: localStorage.getItem("ns") || null,
+  namespaces: [],
+  user: null,
+};
+
+let toastTimer = null;
+function toast(msg, isError = false) {
+  const t = $("#toast");
+  t.textContent = msg;
+  t.className = isError ? "error" : "";
+  t.hidden = false;
+  clearTimeout(toastTimer);
+  toastTimer = setTimeout(() => { t.hidden = true; }, 4000);
+}
+
+function esc(s) {
+  const d = document.createElement("span");
+  d.textContent = s == null ? "" : String(s);
+  return d.innerHTML;
+}
+
+function age(ts) {
+  if (!ts) return "—";
+  const s = (Date.now() - new Date(ts).getTime()) / 1000;
+  if (s < 90) return `${Math.max(1, Math.round(s))}s`;
+  if (s < 5400) return `${Math.round(s / 60)}m`;
+  if (s < 129600) return `${Math.round(s / 3600)}h`;
+  return `${Math.round(s / 86400)}d`;
+}
+
+function statusCell(st) {
+  const phase = (st && st.phase) || "waiting";
+  const msg = (st && st.message) || "";
+  return `<span class="status" title="${esc(msg)}">
+    <span class="dot ${esc(phase)}"></span>${esc(phase)}</span>`;
+}
+
+// ---- router ----------------------------------------------------------
+
+const routes = [];
+function route(pattern, render) { routes.push({ pattern, render }); }
+
+let activeTimers = [];
+function every(ms, fn) { activeTimers.push(setInterval(fn, ms)); }
+
+async function navigate() {
+  activeTimers.forEach(clearInterval);
+  activeTimers = [];
+  const hash = location.hash.replace(/^#/, "") || "/home";
+  for (const a of document.querySelectorAll("#nav a")) {
+    a.classList.toggle("active", hash.startsWith(a.hash.replace(/^#/, "")));
+  }
+  for (const { pattern, render } of routes) {
+    const m = hash.match(pattern);
+    if (m) {
+      try {
+        await render(...m.slice(1));
+      } catch (e) {
+        view.innerHTML = `<div class="card">${esc(e.message)}</div>`;
+      }
+      return;
+    }
+  }
+  location.hash = "#/home";
+}
+
+// ---- boot: namespaces ------------------------------------------------
+
+async function loadNamespaces() {
+  const data = await get("/jupyter/api/namespaces");
+  state.user = data.user;
+  state.namespaces = data.namespaces;
+  if (!state.namespace || !data.namespaces.includes(state.namespace)) {
+    state.namespace = data.namespaces.find((n) => !n.startsWith("kube")) ||
+      data.namespaces[0];
+  }
+  const sel = $("#ns-select");
+  sel.innerHTML = state.namespaces
+    .map((n) => `<option ${n === state.namespace ? "selected" : ""}>${esc(n)}</option>`)
+    .join("");
+  sel.onchange = () => {
+    state.namespace = sel.value;
+    localStorage.setItem("ns", sel.value);
+    navigate();
+  };
+  $("#whoami").textContent = data.user || "";
+}
+
+// ---- home ------------------------------------------------------------
+
+route(/^\/home$/, async () => {
+  const ns = state.namespace;
+  const [links, metrics, activities] = await Promise.all([
+    get("/api/dashboard-links"),
+    get("/api/metrics"),
+    get(`/api/activities/${ns}`).catch(() => ({ activities: [] })),
+  ]);
+  const m = metrics.metrics || {};
+  view.innerHTML = `
+    <div class="card">
+      <h2>TPU fleet</h2>
+      <p class="sub">Live accelerator inventory</p>
+      <div class="row">
+        <span class="pill">${esc(m.nodes ?? "–")} TPU nodes</span>
+        <span class="pill">${esc(m.chips_capacity ?? "–")} chips capacity</span>
+        <span class="pill">${esc(m.chips_requested ?? "–")} chips in use</span>
+        <span class="pill">${esc(m.notebooks_running ?? "–")} notebooks running</span>
+      </div>
+    </div>
+    <div class="card quick-links">
+      <h2>Quick shortcuts</h2>
+      ${(links.links?.quickLinks || [])
+        .map((l) => `<a href="#/notebooks/new">${esc(l.desc)}</a>`)
+        .join("") || '<a href="#/notebooks/new">Create a new Notebook server</a>'}
+    </div>
+    <div class="card">
+      <h2>Recent activity <span class="pill">${esc(ns)}</span></h2>
+      <table><tbody id="act"></tbody></table>
+    </div>`;
+  $("#act").innerHTML = (activities.activities || [])
+    .slice(0, 12)
+    .map((e) => `<tr>
+        <td>${esc(e.type)}</td><td>${esc(e.reason)}</td>
+        <td>${esc(e.involvedObject?.kind)}/${esc(e.involvedObject?.name)}</td>
+        <td>${esc(e.message)}</td>
+        <td>${age(e.lastTimestamp)}</td></tr>`)
+    .join("") || `<tr><td class="empty">No recent events</td></tr>`;
+});
+
+// ---- notebooks table -------------------------------------------------
+
+route(/^\/notebooks$/, async () => {
+  const ns = state.namespace;
+  view.innerHTML = `
+    <div class="card">
+      <div class="row" style="justify-content: space-between">
+        <div><h2>Notebook servers</h2>
+          <p class="sub">TPU slices in <b>${esc(ns)}</b></p></div>
+        <button class="primary" id="new-nb">+ New Notebook</button>
+      </div>
+      <table>
+        <thead><tr><th>Status</th><th>Name</th><th>Image</th>
+          <th>TPU slice</th><th>Age</th><th></th></tr></thead>
+        <tbody id="nb-rows"></tbody>
+      </table>
+    </div>`;
+  $("#new-nb").onclick = () => { location.hash = "#/notebooks/new"; };
+
+  async function refresh() {
+    const data = await get(`/jupyter/api/namespaces/${ns}/notebooks`);
+    const rows = data.notebooks.map((nb) => {
+      const stopped = nb.status?.phase === "stopped";
+      const tpu = nb.tpu
+        ? `${nb.tpu.acceleratorType} · ${nb.tpu.chips} chips / ${nb.tpu.hosts} hosts`
+        : "none";
+      return `<tr class="clickable" data-name="${esc(nb.name)}">
+        <td>${statusCell(nb.status)}</td>
+        <td><b>${esc(nb.name)}</b></td>
+        <td title="${esc(nb.image)}">${esc((nb.image || "").split("/").pop())}</td>
+        <td>${esc(tpu)}</td>
+        <td>${age(nb.age)}</td>
+        <td class="actions">
+          <a class="btn" data-act="connect"
+             href="/notebook/${esc(ns)}/${esc(nb.name)}/"
+             target="_blank" ${nb.status?.phase !== "ready" ? "hidden" : ""}>Connect</a>
+          <button data-act="${stopped ? "start" : "stop"}">${stopped ? "Start" : "Stop"}</button>
+          <button data-act="delete" class="danger">Delete</button>
+        </td></tr>`;
+    });
+    $("#nb-rows").innerHTML = rows.join("") ||
+      `<tr><td colspan="6" class="empty">No notebooks yet — create one.</td></tr>`;
+  }
+
+  $("#nb-rows").onclick = async (ev) => {
+    const row = ev.target.closest("tr[data-name]");
+    if (!row) return;
+    const name = row.dataset.name;
+    const act = ev.target.dataset.act;
+    if (act === "connect") return; // the <a> handles it
+    try {
+      if (act === "stop") {
+        await patch(`/jupyter/api/namespaces/${ns}/notebooks/${name}`, { stopped: true });
+        toast(`Stopping ${name}`);
+      } else if (act === "start") {
+        await patch(`/jupyter/api/namespaces/${ns}/notebooks/${name}`, { stopped: false });
+        toast(`Starting ${name}`);
+      } else if (act === "delete") {
+        if (!confirm(`Delete notebook ${name}?`)) return;
+        await del(`/jupyter/api/namespaces/${ns}/notebooks/${name}`);
+        toast(`Deleted ${name}`);
+      } else {
+        location.hash = `#/notebooks/${name}`;
+        return;
+      }
+      await refresh();
+    } catch (e) { toast(e.message, true); }
+  };
+
+  await refresh();
+  every(3000, () => refresh().catch(() => {}));
+});
+
+// ---- spawner form ----------------------------------------------------
+
+route(/^\/notebooks\/new$/, async () => {
+  const ns = state.namespace;
+  const [cfgData, tpuData] = await Promise.all([
+    get("/jupyter/api/config"),
+    get("/jupyter/api/tpus"),
+  ]);
+  const cfg = cfgData.config || {};
+  const field = (k) => cfg[k] || {};
+  const ro = (k) => (field(k).readOnly ? "disabled" : "");
+  const imageOpts = field("image").options || [];
+  const tpus = tpuData.tpus || [];
+
+  view.innerHTML = `
+    <div class="card">
+      <h2>New notebook server</h2>
+      <p class="sub">Namespace <b>${esc(ns)}</b> — chips, hosts and node
+        selectors derive from the slice preset server-side.</p>
+      <form id="spawn">
+        <div class="field">
+          <label for="f-name">Name</label>
+          <input type="text" id="f-name" required
+                 pattern="[a-z0-9]([-a-z0-9]*[a-z0-9])?"
+                 placeholder="my-notebook">
+        </div>
+        <div class="grid2">
+          <div class="field">
+            <label for="f-image">Image</label>
+            <select id="f-image" ${ro("image")}>
+              ${imageOpts.map((o) => `<option ${o === field("image").value ? "selected" : ""}>${esc(o)}</option>`).join("")}
+            </select>
+            ${field("image").readOnly ? '<p class="hint">Pinned by your admin</p>' : ""}
+          </div>
+          <div class="field">
+            <label for="f-servertype">Server type</label>
+            <select id="f-servertype" ${ro("serverType")}>
+              <option ${field("serverType").value === "jupyter" ? "selected" : ""}>jupyter</option>
+              <option ${field("serverType").value === "group-one" ? "selected" : ""}>group-one</option>
+            </select>
+          </div>
+          <div class="field">
+            <label for="f-cpu">CPU</label>
+            <input type="text" id="f-cpu" value="${esc(field("cpu").value || "4")}" ${ro("cpu")}>
+          </div>
+          <div class="field">
+            <label for="f-memory">Memory</label>
+            <input type="text" id="f-memory" value="${esc(field("memory").value || "16Gi")}" ${ro("memory")}>
+          </div>
+        </div>
+        <div class="field">
+          <label>TPU slice</label>
+          <div class="slice-picker" id="f-tpus">
+            <span class="slice-chip selected" data-accel="none">none</span>
+            ${tpus.map((t) => `<span class="slice-chip" data-accel="${esc(t.acceleratorType)}"
+                title="topology ${esc(t.topology)}">${esc(t.acceleratorType)}
+                · ${esc(t.chips)} chips / ${esc(t.hosts)} hosts</span>`).join("")}
+          </div>
+          <p class="hint">Only slice types present in the cluster inventory are offered.</p>
+        </div>
+        <div class="field">
+          <label><input type="checkbox" id="f-workspace" checked>
+            Create a workspace volume (5Gi, mounted at /home/jovyan)</label>
+        </div>
+        <div class="row">
+          <button type="submit" class="primary">Launch</button>
+          <a class="btn" href="#/notebooks">Cancel</a>
+        </div>
+      </form>
+    </div>`;
+
+  let accel = "none";
+  $("#f-tpus").onclick = (ev) => {
+    const chip = ev.target.closest(".slice-chip");
+    if (!chip) return;
+    accel = chip.dataset.accel;
+    for (const c of document.querySelectorAll(".slice-chip")) {
+      c.classList.toggle("selected", c === chip);
+    }
+  };
+
+  $("#spawn").onsubmit = async (ev) => {
+    ev.preventDefault();
+    const name = $("#f-name").value.trim();
+    const body = {
+      name,
+      image: $("#f-image").value,
+      imagePullPolicy: "IfNotPresent",
+      serverType: $("#f-servertype").value,
+      cpu: $("#f-cpu").value,
+      memory: $("#f-memory").value,
+      tpu: accel === "none" ? null : { acceleratorType: accel },
+      tolerationGroup: "none",
+      affinityConfig: "none",
+      configurations: [],
+      shm: true,
+      environment: {},
+      datavols: [],
+    };
+    if ($("#f-workspace").checked) {
+      body.workspace = {
+        mount: "/home/jovyan",
+        newPvc: {
+          metadata: { name: "{notebook-name}-workspace" },
+          spec: {
+            resources: { requests: { storage: "5Gi" } },
+            accessModes: ["ReadWriteOnce"],
+          },
+        },
+      };
+    }
+    try {
+      await post(`/jupyter/api/namespaces/${ns}/notebooks`, body);
+      toast(`Notebook ${name} created`);
+      location.hash = "#/notebooks";
+    } catch (e) { toast(e.message, true); }
+  };
+});
+
+// ---- notebook detail: status ladder, events, per-ordinal logs --------
+
+route(/^\/notebooks\/([a-z0-9][-a-z0-9]*)$/, async (name) => {
+  const ns = state.namespace;
+
+  view.innerHTML = `
+    <div class="card">
+      <div class="row" style="justify-content: space-between">
+        <h2>${esc(name)} <span id="d-status"></span></h2>
+        <a class="btn" href="#/notebooks">← Back</a>
+      </div>
+      <dl class="kv" id="d-kv"></dl>
+    </div>
+    <div class="card">
+      <h2>Slice pods</h2>
+      <p class="sub">One pod per TPU host; click to inspect its logs.</p>
+      <div class="tabs" id="d-pods"></div>
+      <div class="logbox" id="d-logs">select a pod</div>
+    </div>
+    <div class="card">
+      <h2>Events</h2>
+      <table><thead><tr><th>Type</th><th>Reason</th><th>Message</th>
+        <th>Age</th></tr></thead><tbody id="d-events"></tbody></table>
+    </div>`;
+
+  let currentPod = null;
+
+  async function refreshDetail() {
+    const data = await get(`/jupyter/api/namespaces/${ns}/notebooks/${name}`);
+    const nb = data.notebook;
+    $("#d-status").innerHTML = statusCell(nb.processed_status);
+    const tpu = nb.spec?.tpu || {};
+    $("#d-kv").innerHTML = `
+      <dt>Image</dt><dd>${esc(nb.spec?.template?.spec?.containers?.[0]?.image)}</dd>
+      <dt>TPU slice</dt><dd>${esc(tpu.acceleratorType || "none")}</dd>
+      <dt>Ready / desired hosts</dt>
+      <dd>${esc(nb.status?.readyReplicas ?? 0)} / ${esc(nb.status?.desiredReplicas ?? 0)}</dd>
+      <dt>Conditions</dt>
+      <dd>${(nb.status?.conditions || []).map((c) => `${esc(c.type)}=${esc(c.status)}`).join(", ") || "—"}</dd>
+      <dt>Connect</dt>
+      <dd><a href="/notebook/${esc(ns)}/${esc(name)}/" target="_blank">/notebook/${esc(ns)}/${esc(name)}/</a></dd>`;
+  }
+
+  async function refreshPods() {
+    const data = await get(`/jupyter/api/namespaces/${ns}/notebooks/${name}/pods`);
+    $("#d-pods").innerHTML = data.pods
+      .map((p, i) => `<button data-pod="${i}" class="${p.name === currentPod ? "active" : ""}">
+         ${esc(p.name)} · ${esc(p.phase || "Pending")}</button>`)
+      .join("") || '<span class="empty">no pods yet</span>';
+    if (!currentPod && data.pods.length) {
+      currentPod = data.pods[0].name;
+      await refreshLogs();
+    }
+  }
+
+  async function refreshLogs() {
+    if (!currentPod) return;
+    const ordinal = currentPod.split("-").pop();
+    const data = await get(
+      `/jupyter/api/namespaces/${ns}/notebooks/${name}/pods/${ordinal}/logs`);
+    $("#d-logs").textContent = data.logs.join("\n") || "(no output yet)";
+  }
+
+  async function refreshEvents() {
+    const data = await get(`/jupyter/api/namespaces/${ns}/notebooks/${name}/events`);
+    $("#d-events").innerHTML = data.events
+      .map((e) => `<tr><td>${esc(e.type)}</td><td>${esc(e.reason)}</td>
+           <td>${esc(e.message)}</td><td>${age(e.lastTimestamp)}</td></tr>`)
+      .join("") || `<tr><td colspan="4" class="empty">No events</td></tr>`;
+  }
+
+  $("#d-pods").onclick = async (ev) => {
+    const b = ev.target.closest("button[data-pod]");
+    if (!b) return;
+    currentPod = b.textContent.trim().split(" ")[0].replace(/·.*/, "").trim();
+    for (const x of document.querySelectorAll("#d-pods button")) {
+      x.classList.toggle("active", x === b);
+    }
+    await refreshLogs();
+  };
+
+  await Promise.all([refreshDetail(), refreshPods(), refreshEvents()]);
+  every(3000, () => Promise.all(
+    [refreshDetail(), refreshPods(), refreshLogs(), refreshEvents()],
+  ).catch(() => {}));
+});
+
+// ---- volumes ---------------------------------------------------------
+
+route(/^\/volumes$/, async () => {
+  const ns = state.namespace;
+  view.innerHTML = `
+    <div class="card">
+      <h2>Volumes</h2>
+      <p class="sub">PersistentVolumeClaims in <b>${esc(ns)}</b></p>
+      <table>
+        <thead><tr><th>Name</th><th>Size</th><th>Access</th>
+          <th>Used by</th><th>Viewer</th><th></th></tr></thead>
+        <tbody id="pvc-rows"></tbody>
+      </table>
+    </div>`;
+
+  async function refresh() {
+    const data = await get(`/volumes/api/namespaces/${ns}/pvcs`);
+    $("#pvc-rows").innerHTML = data.pvcs
+      .map((row) => {
+        const pvc = row.pvc;
+        const name = pvc.metadata.name;
+        return `<tr data-name="${esc(name)}">
+          <td><b>${esc(name)}</b></td>
+          <td>${esc(pvc.spec?.resources?.requests?.storage)}</td>
+          <td>${esc((pvc.spec?.accessModes || []).join(","))}</td>
+          <td>${esc(row.inUseBy.join(", ") || "—")}</td>
+          <td>${row.viewer ? esc(row.viewer) : "—"}</td>
+          <td class="actions">
+            <button data-act="browse">${row.viewer ? "Close browser" : "Browse"}</button>
+            <button data-act="delete" class="danger"
+              ${row.inUseBy.length ? "disabled title='in use'" : ""}>Delete</button>
+          </td></tr>`;
+      })
+      .join("") || `<tr><td colspan="6" class="empty">No volumes</td></tr>`;
+  }
+
+  $("#pvc-rows").onclick = async (ev) => {
+    const row = ev.target.closest("tr[data-name]");
+    const act = ev.target.dataset.act;
+    if (!row || !act) return;
+    const name = row.dataset.name;
+    try {
+      if (act === "browse") {
+        const hasViewer = ev.target.textContent.includes("Close");
+        if (hasViewer) {
+          await del(`/volumes/api/namespaces/${ns}/viewers/${name}`);
+          toast("Viewer deleted");
+        } else {
+          await post(`/volumes/api/namespaces/${ns}/viewers/${name}`);
+          toast("Viewer starting — it appears in the table when ready");
+        }
+      } else if (act === "delete") {
+        if (!confirm(`Delete PVC ${name}?`)) return;
+        await del(`/volumes/api/namespaces/${ns}/pvcs/${name}`);
+        toast(`Deleted ${name}`);
+      }
+      await refresh();
+    } catch (e) { toast(e.message, true); }
+  };
+
+  await refresh();
+  every(4000, () => refresh().catch(() => {}));
+});
+
+// ---- tensorboards ----------------------------------------------------
+
+route(/^\/tensorboards$/, async () => {
+  const ns = state.namespace;
+  view.innerHTML = `
+    <div class="card">
+      <h2>Tensorboards</h2>
+      <p class="sub">Serving from PVC or GCS log dirs in <b>${esc(ns)}</b></p>
+      <table>
+        <thead><tr><th>Status</th><th>Name</th><th>Logspath</th>
+          <th>Age</th><th></th></tr></thead>
+        <tbody id="tb-rows"></tbody>
+      </table>
+    </div>
+    <div class="card">
+      <h2>New tensorboard</h2>
+      <form id="tb-form" class="row">
+        <input type="text" id="tb-name" placeholder="name" required
+               pattern="[a-z0-9]([-a-z0-9]*[a-z0-9])?">
+        <input type="text" id="tb-logspath" required
+               placeholder="pvc://my-pvc/logs or gs://bucket/dir">
+        <button type="submit" class="primary">Create</button>
+      </form>
+    </div>`;
+
+  async function refresh() {
+    const data = await get(`/tensorboards/api/namespaces/${ns}/tensorboards`);
+    $("#tb-rows").innerHTML = data.tensorboards
+      .map((tb) => `<tr data-name="${esc(tb.name)}">
+          <td>${statusCell(tb.status)}</td>
+          <td><b>${esc(tb.name)}</b></td>
+          <td>${esc(tb.logspath)}</td>
+          <td>${age(tb.age)}</td>
+          <td class="actions">
+            <button data-act="delete" class="danger">Delete</button>
+          </td></tr>`)
+      .join("") || `<tr><td colspan="5" class="empty">No tensorboards</td></tr>`;
+  }
+
+  $("#tb-rows").onclick = async (ev) => {
+    const row = ev.target.closest("tr[data-name]");
+    if (!row || ev.target.dataset.act !== "delete") return;
+    try {
+      await del(`/tensorboards/api/namespaces/${ns}/tensorboards/${row.dataset.name}`);
+      toast("Deleted");
+      await refresh();
+    } catch (e) { toast(e.message, true); }
+  };
+
+  $("#tb-form").onsubmit = async (ev) => {
+    ev.preventDefault();
+    try {
+      await post(`/tensorboards/api/namespaces/${ns}/tensorboards`, {
+        name: $("#tb-name").value.trim(),
+        logspath: $("#tb-logspath").value.trim(),
+      });
+      toast("Tensorboard created");
+      await refresh();
+    } catch (e) { toast(e.message, true); }
+  };
+
+  await refresh();
+  every(4000, () => refresh().catch(() => {}));
+});
+
+// ---- members (KFAM) --------------------------------------------------
+
+route(/^\/members$/, async () => {
+  const ns = state.namespace;
+  view.innerHTML = `
+    <div class="card">
+      <h2>Contributors <span class="pill">${esc(ns)}</span></h2>
+      <table>
+        <thead><tr><th>User</th><th>Role</th><th></th></tr></thead>
+        <tbody id="mb-rows"></tbody>
+      </table>
+    </div>
+    <div class="card">
+      <h2>Add contributor</h2>
+      <form id="mb-form" class="row">
+        <input type="text" id="mb-user" placeholder="user@example.com" required>
+        <select id="mb-role"><option>edit</option><option>view</option></select>
+        <button type="submit" class="primary">Add</button>
+      </form>
+    </div>`;
+
+  async function refresh() {
+    const data = await get(`/kfam/kfam/v1/bindings?namespace=${ns}`);
+    $("#mb-rows").innerHTML = (data.bindings || [])
+      .map((b) => `<tr data-user="${esc(b.user?.name)}" data-role="${esc(b.roleRef?.name)}">
+          <td>${esc(b.user?.name)}</td>
+          <td>${esc(b.roleRef?.name)}</td>
+          <td class="actions"><button data-act="remove" class="danger">Remove</button></td>
+        </tr>`)
+      .join("") || `<tr><td colspan="3" class="empty">No contributors</td></tr>`;
+  }
+
+  $("#mb-rows").onclick = async (ev) => {
+    const row = ev.target.closest("tr[data-user]");
+    if (!row || ev.target.dataset.act !== "remove") return;
+    try {
+      await api("DELETE", "/kfam/kfam/v1/bindings", {
+        user: { kind: "User", name: row.dataset.user },
+        referredNamespace: ns,
+        roleRef: { kind: "ClusterRole", name: row.dataset.role },
+      });
+      toast("Contributor removed");
+      await refresh();
+    } catch (e) { toast(e.message, true); }
+  };
+
+  $("#mb-form").onsubmit = async (ev) => {
+    ev.preventDefault();
+    try {
+      await post("/kfam/kfam/v1/bindings", {
+        user: { kind: "User", name: $("#mb-user").value.trim() },
+        referredNamespace: ns,
+        roleRef: { kind: "ClusterRole",
+                   name: $("#mb-role").value === "view" ? "view" : "edit" },
+      });
+      toast("Contributor added");
+      await refresh();
+    } catch (e) { toast(e.message, true); }
+  };
+
+  await refresh();
+});
+
+// ---- boot ------------------------------------------------------------
+
+window.addEventListener("hashchange", navigate);
+loadNamespaces()
+  .then(navigate)
+  .catch((e) => { view.innerHTML = `<div class="card">${esc(e.message)}</div>`; });

@@ -22,9 +22,9 @@ from kubeflow_rm_tpu.controlplane.webapps.core import WebApp, json_body
 
 
 def create_app(api: APIServer, *, disable_auth: bool = False,
-               prefix: str = "") -> WebApp:
+               prefix: str = "", **app_kwargs) -> WebApp:
     app = WebApp("tensorboards", api, prefix=prefix,
-                 disable_auth=disable_auth)
+                 disable_auth=disable_auth, **app_kwargs)
 
     @app.route("/api/namespaces/<namespace>/tensorboards")
     def list_tensorboards(req, namespace):

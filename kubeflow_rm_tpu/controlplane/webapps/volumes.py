@@ -30,8 +30,8 @@ DEFAULT_VIEWER_SPEC = {"pvc": "$PVC_NAME"}
 
 
 def create_app(api: APIServer, *, viewer_spec: dict | None = None,
-               disable_auth: bool = False, prefix: str = "") -> WebApp:
-    app = WebApp("volumes", api, prefix=prefix, disable_auth=disable_auth)
+               disable_auth: bool = False, prefix: str = "", **app_kwargs) -> WebApp:
+    app = WebApp("volumes", api, prefix=prefix, disable_auth=disable_auth, **app_kwargs)
     spec_template = viewer_spec or DEFAULT_VIEWER_SPEC
 
     @app.route("/api/namespaces/<namespace>/pvcs")

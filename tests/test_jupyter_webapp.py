@@ -235,6 +235,14 @@ def test_pods_and_per_ordinal_logs(stack, app):
         "/api/namespaces/team/notebooks/mynb/pods/1/logs?tailLines=1"
     ).get_data())
     assert len(tail["logs"]) == 1
+    # kube tailLines semantics: 0 -> nothing, garbage -> 400
+    zero = json.loads(client.get(
+        "/api/namespaces/team/notebooks/mynb/pods/1/logs?tailLines=0"
+    ).get_data())
+    assert zero["logs"] == []
+    assert client.get(
+        "/api/namespaces/team/notebooks/mynb/pods/1/logs?tailLines=abc"
+    ).status_code == 400
 
     # unknown ordinal -> 404
     resp = client.get("/api/namespaces/team/notebooks/mynb/pods/9/logs")

@@ -266,33 +266,35 @@ def webapp_objects() -> list[dict]:
             ("kfam", "kfam", 8081),
             ("dashboard", "dashboard", 8082)):
         objs.extend(_webapp_pair(name, cmd, port))
-        objs.append(_webapp_virtualservice(name, port))
+    objs.append(_gateway_virtualservice())
     return objs
 
 
-def _webapp_virtualservice(name: str, port: int) -> dict:
-    """Path-route each web app behind the gateway the way the reference
-    dashboard proxies them (``centraldashboard/app/server.ts:56-91``):
-    /jupyter → JWA, /volumes → VWA, ... and / → the dashboard itself.
-    No rewrite: each app serves its routes under its own prefix
-    (APP_PREFIX in ``_webapp_pair``), and the destination port is the
-    SERVICE port (Istio resolves VS destinations against Service ports,
-    not container ports)."""
-    prefix = ROUTE_PREFIXES[name] + "/"
+def _gateway_virtualservice() -> dict:
+    """ONE VirtualService path-routing every web app behind the gateway
+    (the reference dashboard's proxy table,
+    ``centraldashboard/app/server.ts:56-91``). A single resource with
+    ordered routes — dashboard's "/" catch-all LAST — because Istio's
+    cross-resource merge order for the same host is undefined; within
+    one VirtualService route order is contractual. No rewrites: each
+    app serves its routes under its own prefix (APP_PREFIX in
+    ``_webapp_pair``); destinations use the SERVICE port (80)."""
+    ordered = sorted(ROUTE_PREFIXES.items(),
+                     key=lambda kv: -len(kv[1]))  # "/" last
     return {
         "apiVersion": "networking.istio.io/v1beta1",
         "kind": "VirtualService",
-        "metadata": {"name": name, "namespace": "kubeflow"},
+        "metadata": {"name": "kubeflow-webapps", "namespace": "kubeflow"},
         "spec": {
             "hosts": ["*"],
             "gateways": ["kubeflow/kubeflow-gateway"],
             "http": [{
-                "match": [{"uri": {"prefix": prefix}}],
+                "match": [{"uri": {"prefix": prefix + "/"}}],
                 "route": [{"destination": {
                     "host": f"{name}.kubeflow.svc.cluster.local",
                     "port": {"number": 80},
                 }}],
-            }],
+            } for name, prefix in ordered],
         },
     }
 

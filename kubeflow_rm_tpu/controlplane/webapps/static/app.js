@@ -63,9 +63,14 @@ function toast(msg, isError = false) {
 }
 
 function esc(s) {
-  const d = document.createElement("span");
-  d.textContent = s == null ? "" : String(s);
-  return d.innerHTML;
+  // attribute-safe escaping: quotes must be covered because esc() is
+  // interpolated into double-quoted HTML attributes (title=, data-*)
+  return String(s == null ? "" : s)
+    .replace(/&/g, "&amp;")
+    .replace(/</g, "&lt;")
+    .replace(/>/g, "&gt;")
+    .replace(/"/g, "&quot;")
+    .replace(/'/g, "&#39;");
 }
 
 function age(ts) {

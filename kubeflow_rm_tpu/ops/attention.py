@@ -62,12 +62,12 @@ def flash_eligible(q, k, *, causal, positions_q, bias) -> bool:
     tile the block sizes the kernel will actually pick.
     """
     from kubeflow_rm_tpu.ops.flash_attention import (
-        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, pick_block,
     )
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    bq = min(DEFAULT_BLOCK_Q, Tq)
-    bk = min(DEFAULT_BLOCK_K, Tk)
+    bq = pick_block(DEFAULT_BLOCK_Q, Tq)
+    bk = pick_block(DEFAULT_BLOCK_K, Tk)
     return (causal and bias is None and positions_q is None
             and Tq == Tk and Tq % bq == 0 and Tq % bk == 0
             and D % 8 == 0)

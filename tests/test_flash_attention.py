@@ -137,19 +137,35 @@ def test_llama_forward_with_flash_matches_xla():
 
 
 def test_auto_eligibility_mirrors_kernel_blocks():
-    """auto must not select flash for shapes the kernel would reject
-    (T that tiles 128 but not the actual default block size)."""
+    """Any T that tiles some 128-multiple block stays on the kernel:
+    pick_block degrades the preferred block to a divisor of T, so
+    lengths like DEFAULT_BLOCK_Q + 128 are eligible AND correct."""
     from kubeflow_rm_tpu.ops.attention import flash_eligible
-    from kubeflow_rm_tpu.ops.flash_attention import DEFAULT_BLOCK_Q
+    from kubeflow_rm_tpu.ops.flash_attention import (
+        DEFAULT_BLOCK_Q, pick_block,
+    )
 
-    T_bad = DEFAULT_BLOCK_Q + 128  # tiles 128, not DEFAULT_BLOCK_Q
-    q = jnp.zeros((1, T_bad, 2, 8))
-    k = jnp.zeros((1, T_bad, 2, 8))
-    assert not flash_eligible(q, k, causal=True, positions_q=None,
-                              bias=None)
+    assert pick_block(1024, 2048) == 1024
+    assert pick_block(1024, 1152) == 384   # 1152 = 3 * 384
+    assert pick_block(1024, 1280) == 640  # 1280 = 2 * 640
+    assert pick_block(256, 16) == 16       # short sequences: block = T
+
+    T_odd = DEFAULT_BLOCK_Q + 128
+    q = jnp.zeros((1, T_odd, 2, 8))
+    assert flash_eligible(q, q, causal=True, positions_q=None, bias=None)
     q = jnp.zeros((1, DEFAULT_BLOCK_Q * 2, 2, 8))
-    k = jnp.zeros((1, DEFAULT_BLOCK_Q * 2, 2, 8))
-    assert flash_eligible(q, k, causal=True, positions_q=None, bias=None)
+    assert flash_eligible(q, q, causal=True, positions_q=None, bias=None)
+
+    # numeric correctness at a non-power-of-two multiple (T=384 keeps
+    # the interpreter fast; preferred 1024 degrades to block 384)
+    key = jax.random.key(0)
+    B, T, H, D = 1, 384, 2, 8
+    qkv = jax.random.normal(key, (3, B, T, H, D), jnp.float32)
+    out = flash_attention(qkv[0], qkv[1], qkv[2], causal=True)
+    ref = dot_product_attention(qkv[0], qkv[1], qkv[2], causal=True,
+                                impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_forced_flash_rejects_bias_and_positions():

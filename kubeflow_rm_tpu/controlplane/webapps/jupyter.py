@@ -71,8 +71,18 @@ def _container(nb: dict) -> dict:
 
 
 def set_image(nb: dict, body: dict, defaults: dict) -> None:
-    field = "customImage" if body.get("customImage") else "image"
-    image = get_form_value(body, defaults, field, "image")
+    """Image resolution per server type (reference form.py set_image):
+    jupyter reads ``image``, group-one ``imageGroupOne`` (codeserver),
+    group-two ``imageGroupTwo`` (rstudio); ``customImage`` overrides
+    any of them subject to the base field's readOnly."""
+    server_type = body.get("serverType") or deep_get(
+        defaults, "serverType", "value", default="jupyter")
+    group_field = {"group-one": "imageGroupOne",
+                   "group-two": "imageGroupTwo"}.get(server_type, "image")
+    if body.get("customImage"):
+        image = get_form_value(body, defaults, "customImage", group_field)
+    else:
+        image = get_form_value(body, defaults, group_field)
     _container(nb)["image"] = image.strip()
     policy = get_form_value(body, defaults, "imagePullPolicy")
     _container(nb)["imagePullPolicy"] = policy

@@ -265,6 +265,11 @@ route(/^\/notebooks\/new$/, async () => {
   const cfg = cfgData.config || {};
   const field = (k) => cfg[k] || {};
   const ro = (k) => (field(k).readOnly ? "disabled" : "");
+  // per-server-type image field (backend set_image contract)
+  const imageFieldFor = (st) => ({
+    "group-one": "imageGroupOne",
+    "group-two": "imageGroupTwo",
+  }[st] || "image");
   const imageOpts = field("image").options || [];
   const tpus = tpuData.tpus || [];
 
@@ -291,9 +296,10 @@ route(/^\/notebooks\/new$/, async () => {
           <div class="field">
             <label for="f-servertype">Server type</label>
             <select id="f-servertype" ${ro("serverType")}>
-              <option ${field("serverType").value === "jupyter" ? "selected" : ""}>jupyter</option>
-              <option ${field("serverType").value === "group-one" ? "selected" : ""}>group-one</option>
+              ${["jupyter", "group-one", "group-two"].map((st) =>
+                `<option ${field("serverType").value === st ? "selected" : ""}>${st}</option>`).join("")}
             </select>
+            <p class="hint">jupyter · group-one = VSCode · group-two = RStudio</p>
           </div>
           <div class="field">
             <label for="f-cpu">CPU</label>
@@ -325,6 +331,14 @@ route(/^\/notebooks\/new$/, async () => {
       </form>
     </div>`;
 
+  // server type drives which image list the dropdown offers
+  $("#f-servertype").onchange = () => {
+    const f = field(imageFieldFor($("#f-servertype").value));
+    $("#f-image").innerHTML = (f.options || [])
+      .map((o) => `<option ${o === f.value ? "selected" : ""}>${esc(o)}</option>`)
+      .join("");
+  };
+
   let accel = "none";
   $("#f-tpus").onclick = (ev) => {
     const chip = ev.target.closest(".slice-chip");
@@ -338,11 +352,12 @@ route(/^\/notebooks\/new$/, async () => {
   $("#spawn").onsubmit = async (ev) => {
     ev.preventDefault();
     const name = $("#f-name").value.trim();
+    const serverType = $("#f-servertype").value;
     const body = {
       name,
-      image: $("#f-image").value,
+      [imageFieldFor(serverType)]: $("#f-image").value,
       imagePullPolicy: "IfNotPresent",
-      serverType: $("#f-servertype").value,
+      serverType,
       cpu: $("#f-cpu").value,
       memory: $("#f-memory").value,
       tpu: accel === "none" ? null : { acceleratorType: accel },

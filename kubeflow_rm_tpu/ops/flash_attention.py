@@ -54,14 +54,20 @@ DEFAULT_BLOCK_K = 1024
 
 
 def pick_block(preferred: int, T: int) -> int:
-    """Largest block <= preferred that divides T (tries multiples of
-    128 down to 128, then T itself for short sequences)."""
+    """Block size for a length-T sequence: the preferred block when it
+    divides T (explicit requests, incl. sub-128 test blocks, are
+    honored), else the largest 128-multiple divisor of T. Returns 0
+    when no VMEM-safe block exists (long T with no such divisor) — the
+    caller must reject rather than launch a full-length score block."""
     b = min(preferred, T)
+    if T % b == 0:
+        return b
+    b = (b // 128) * 128
     while b >= 128:
         if T % b == 0:
             return b
         b -= 128
-    return T
+    return T if T < 128 else 0
 
 
 # ---------------------------------------------------------------------
@@ -593,9 +599,9 @@ def flash_attention(
     group = H // KVH
     block_q = pick_block(block_q, T)
     block_k = pick_block(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(f"T={T} must tile by block sizes "
-                         f"({block_q}, {block_k})")
+    if not block_q or not block_k:
+        raise ValueError(
+            f"T={T} has no 128-multiple block divisor; use the XLA path")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 

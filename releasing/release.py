@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Release tooling — the ``releasing/`` machinery of the reference
+(version bump + image-tag pinning + tag instructions), one script.
+
+    python releasing/release.py prepare v0.4.0 [--dry-run]
+        - validates the version string
+        - writes releasing/VERSION
+        - pins every kustomize image tag (manifests/default) and the
+          spawner config's image tags to the release version
+        - prints the git tag / push steps (never runs git itself)
+
+    python releasing/release.py check
+        - verifies VERSION, the kustomize pin, and the spawner config
+          agree (CI guard; exits non-zero on drift)
+
+The image DAG itself is built/pushed by CI on the tag
+(.github/workflows/image_build.yaml) — this script only moves the
+version forward consistently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+VERSION_FILE = ROOT / "releasing/VERSION"
+KUSTOMIZATION = ROOT / "manifests/default/kustomization.yaml"
+SPAWNER_CONFIG = (
+    ROOT / "kubeflow_rm_tpu/controlplane/webapps/spawner_ui_config.yaml")
+
+VERSION_RE = re.compile(r"^v\d+\.\d+\.\d+(-rc\.\d+)?$")
+
+
+def current_version() -> str:
+    return VERSION_FILE.read_text().strip()
+
+
+def _pin_kustomization(version: str, dry: bool) -> None:
+    text = KUSTOMIZATION.read_text()
+    new = re.sub(r"newTag: \S+", f"newTag: {version}", text)
+    _write(KUSTOMIZATION, new, dry)
+
+
+def _pin_spawner_images(version: str, dry: bool) -> None:
+    """Image options in the spawner config track the release so fresh
+    deployments offer the pinned, CI-built tags."""
+    text = SPAWNER_CONFIG.read_text()
+    new = re.sub(r"(ghcr\.io/kubeflow-rm-tpu/[a-z0-9-]+):\S+",
+                 rf"\1:{version}", text)
+    _write(SPAWNER_CONFIG, new, dry)
+
+
+def _write(path: pathlib.Path, content: str, dry: bool) -> None:
+    import os
+    rel = os.path.relpath(path, ROOT)
+    if dry:
+        print(f"would write {rel}")
+    else:
+        path.write_text(content)
+        print(f"wrote {rel}")
+
+
+def cmd_prepare(version: str, dry: bool) -> int:
+    if not VERSION_RE.match(version):
+        print(f"bad version {version!r} (want vX.Y.Z[-rc.N])",
+              file=sys.stderr)
+        return 2
+    _write(VERSION_FILE, version + "\n", dry)
+    _pin_kustomization(version, dry)
+    _pin_spawner_images(version, dry)
+    print(f"""
+release {version} prepared. Next:
+  git add -A && git commit -m "Release {version}"
+  git tag {version} && git push origin main {version}
+CI builds and pushes the image DAG for the tag
+(.github/workflows/image_build.yaml); deploy with
+  kustomize build manifests/overlays/standalone | kubectl apply -f -""")
+    return 0
+
+
+def cmd_check() -> int:
+    version = current_version()
+    problems = []
+    if not VERSION_RE.match(version) and version != "latest":
+        problems.append(f"VERSION {version!r} is not vX.Y.Z")
+    kust = KUSTOMIZATION.read_text()
+    tags = set(re.findall(r"newTag: (\S+)", kust))
+    if tags - {version, "latest"}:
+        problems.append(f"kustomize newTag {tags} != VERSION {version}")
+    spawn_tags = set(re.findall(
+        r"ghcr\.io/kubeflow-rm-tpu/[a-z0-9-]+:(\S+)",
+        SPAWNER_CONFIG.read_text()))
+    if spawn_tags - {version, "latest"}:
+        problems.append(
+            f"spawner config tags {spawn_tags} != VERSION {version}")
+    for p in problems:
+        print("DRIFT:", p, file=sys.stderr)
+    print("ok" if not problems else f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    prep = sub.add_parser("prepare")
+    prep.add_argument("version")
+    prep.add_argument("--dry-run", action="store_true")
+    sub.add_parser("check")
+    args = ap.parse_args()
+    if args.cmd == "prepare":
+        return cmd_prepare(args.version, args.dry_run)
+    return cmd_check()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,3 +177,52 @@ def test_forced_flash_rejects_bias_and_positions():
     with pytest.raises(ValueError, match="cannot represent"):
         dot_product_attention(q, k, v, impl="flash",
                               bias=jnp.zeros((1, 2, 128, 128)))
+
+
+def test_pick_block_rejects_unfactorable_lengths():
+    """Long T with no 128-multiple divisor must NOT launch a
+    full-length score block (VMEM blow-up): explicit calls raise, auto
+    falls back to XLA."""
+    from kubeflow_rm_tpu.ops.attention import flash_eligible
+    from kubeflow_rm_tpu.ops.flash_attention import pick_block
+
+    assert pick_block(1024, 8200) == 0  # 8200 = 8 * 1025, no divisor
+    assert pick_block(1024, 100) == 100  # short seqs: block = T
+    assert pick_block(1024, 200) == 200  # single block, VMEM-safe
+    q = jnp.zeros((1, 8200, 2, 8))
+    assert not flash_eligible(q, q, causal=True, positions_q=None,
+                              bias=None)
+    q_l, k_l, v_l = make_qkv(jax.random.key(7), B=1, T=8200, H=1,
+                             KVH=1, D=8)
+    with pytest.raises(ValueError, match="block divisor"):
+        flash_attention(q_l, k_l, v_l, causal=True)
+
+
+def test_flash_packed_segments_gradients():
+    """The backward kernels' segment machinery (seg index maps, the
+    seg branch of the mask) must produce dense-exact gradients."""
+    from kubeflow_rm_tpu.training.data import pack_documents
+
+    rng = np.random.default_rng(1)
+    docs = [rng.integers(1, 50, size=n).tolist() for n in (40, 70, 25)]
+    packed = pack_documents(docs, seq_len=128)
+    seg = jnp.asarray(packed["segments"][:1])
+    pos = jnp.asarray(packed["positions"][:1])
+    q, k, v = make_qkv(jax.random.key(8), B=1, T=128, H=2, KVH=2, D=8)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True, segment_ids_q=seg,
+                                segment_ids_kv=seg, block_q=64,
+                                block_k=64) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(
+            q, k, v, causal=True, positions_q=pos, positions_kv=pos,
+            segment_ids_q=seg, segment_ids_kv=seg, impl="xla") ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5,
+                                   err_msg=f"d{name}")

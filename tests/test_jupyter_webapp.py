@@ -252,3 +252,22 @@ def test_pods_and_per_ordinal_logs(stack, app):
     resp = app.test_client(user="mallory@corp.com").get(
         "/api/namespaces/team/notebooks/mynb/pods/0/logs")
     assert resp.status_code == 403
+
+
+def test_group_two_spawn_uses_rstudio_image(stack, app):
+    """serverType=group-two reads imageGroupTwo (the rstudio images)
+    and gets the URI-rewrite annotation."""
+    api, mgr = stack
+    client = app.test_client(user=USER)
+    body = spawn_body(name="rs", serverType="group-two")
+    del body["image"]
+    body["imageGroupTwo"] = "ghcr.io/kubeflow-rm-tpu/rstudio:latest"
+    body["tpu"] = {"acceleratorType": "none"}
+    resp = post_json(client, "/api/namespaces/team/notebooks", body)
+    assert resp.status_code == 200, resp.get_data()
+    nb = api.get(nb_api.KIND, "rs", "team")
+    c0 = nb["spec"]["template"]["spec"]["containers"][0]
+    assert c0["image"] == "ghcr.io/kubeflow-rm-tpu/rstudio:latest"
+    ann = nb["metadata"]["annotations"]
+    assert ann[nb_api.REWRITE_URI_ANNOTATION] == "/"
+    assert ann[nb_api.SERVER_TYPE_ANNOTATION] == "group-two"

@@ -43,14 +43,27 @@ DEFAULT_CULL_IDLE_TIME_MIN = 1440.0   # culler.go:26
 DEFAULT_CHECK_PERIOD_MIN = 1.0        # culler.go:27
 
 
-def default_probe(notebook: dict, pod0: dict | None):
-    """HTTP probe of worker 0's Jupyter REST API (culler.go:155-180)."""
+import logging
+
+log = logging.getLogger("kubeflow_rm_tpu.culling")
+
+
+def default_probe(notebook: dict, pod0: dict | None,
+                  base_url: str | None = None):
+    """HTTP probe of worker 0's Jupyter REST API (culler.go:155-180).
+
+    ``base_url`` overrides the in-cluster service DNS (tests, port
+    forwards). Per-endpoint failures are logged with their reason — an
+    auth-broken or misconfigured probe must be debuggable from the
+    controller log, not silently identical to an idle server
+    (culler.go:155-221 logs per-endpoint warnings the same way)."""
     import json
     import urllib.request
 
     ns = notebook["metadata"]["namespace"]
     name = notebook["metadata"]["name"]
-    url = f"http://{name}.{ns}.svc.cluster.local/notebook/{ns}/{name}/api"
+    url = base_url or (
+        f"http://{name}.{ns}.svc.cluster.local/notebook/{ns}/{name}/api")
     # per-endpoint failure handling: a server with terminals disabled
     # 404s /api/terminals but still reports busy kernels — discarding
     # the kernel answer would cull an actively-used notebook
@@ -59,8 +72,9 @@ def default_probe(notebook: dict, pod0: dict | None):
         try:
             with urllib.request.urlopen(f"{url}/{kind}", timeout=5) as r:
                 out[kind] = json.load(r)
-        except Exception:
-            pass
+        except Exception as e:
+            log.warning("probe %s/%s: GET %s/%s failed: %r",
+                        ns, name, url, kind, e)
     return out or None  # both unreachable: no activity info this period
 
 
@@ -94,6 +108,17 @@ class CullingController(Controller):
             return requeue
         activity = self.probe_fn(notebook, pod0)
         now = api.clock()
+        if activity is None:
+            # a running pod whose probe is entirely unreachable is a
+            # misconfiguration signal (auth proxy, NetworkPolicy), not
+            # just an idle server — surface it once per incarnation
+            already = any(e.get("reason") == "CullingProbeFailed"
+                          for e in api.events_for(notebook))
+            if not already:
+                api.record_event(
+                    notebook, "Warning", "CullingProbeFailed",
+                    "worker-0 activity probe unreachable; idleness is "
+                    "being measured from the last known activity only")
 
         # activity cannot predate the current incarnation: a restarted
         # slice starts its idle clock at worker-0's start time, so a

@@ -170,3 +170,28 @@ def test_access_review_clusterrolebinding_grants_clusterwide(api):
     assert api.access_review("dave", "create", "profiles")
     assert api.access_review("dave", "create", "profiles", "anywhere")
     assert not api.access_review("dave", "delete", "profiles")
+
+
+def test_topology_table_invariants():
+    """Single source of truth for quota/scheduling/picker: every entry
+    must be internally consistent (chips = topology product adjusted
+    for cores-vs-chips naming, hosts divide chips, 4-chip hosts above
+    single-host sizes)."""
+    import math
+
+    from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+
+    for name, t in tpu_api.TOPOLOGIES.items():
+        dims = [int(x) for x in t.topology.split("x")]
+        assert t.chips == math.prod(dims), name
+        assert t.chips % t.hosts == 0, name
+        if t.multihost:
+            assert t.chips_per_host == 4, name
+        # naming: v5litepod/v6e N = chips; v4/v5p N = TensorCores (2/chip)
+        n = int(name.rsplit("-", 1)[1])
+        if name.startswith(("v5litepod", "v6e")):
+            assert n == t.chips, name
+        else:
+            assert n == 2 * t.chips, name
+        # reverse lookup round-trips
+        assert tpu_api.by_node_labels(t.gke_accelerator, t.topology) == t

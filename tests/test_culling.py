@@ -137,3 +137,71 @@ def test_culled_notebook_restarts_with_state(stack):
     vols = deep_get(pods[0], "spec", "volumes", default=[])
     assert any(deep_get(v, "persistentVolumeClaim", "claimName") == "ws"
                for v in vols)
+
+
+def test_default_probe_against_real_server():
+    """default_probe drives real HTTP: per-endpoint JSON, tolerated
+    404s (terminals disabled), and None when fully unreachable."""
+    import json
+    import threading
+
+    from werkzeug.serving import make_server
+    from werkzeug.wrappers import Request as WzRequest, Response
+
+    from kubeflow_rm_tpu.controlplane.controllers.culling import (
+        default_probe,
+    )
+
+    kernels = [{"execution_state": "idle",
+                "last_activity": "2026-01-01T00:00:00Z"}]
+
+    @WzRequest.application
+    def app(req):
+        if req.path == "/api/kernels":
+            return Response(json.dumps(kernels),
+                            mimetype="application/json")
+        return Response("nope", status=404)
+
+    httpd = make_server("127.0.0.1", 0, app)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    nb = make_notebook("nb", "u")
+    try:
+        base = f"http://127.0.0.1:{httpd.server_port}/api"
+        out = default_probe(nb, None, base_url=base)
+        # kernels served, terminals 404 -> kernel info survives
+        assert out == {"kernels": kernels}
+    finally:
+        httpd.shutdown()
+
+    # fully unreachable -> None (idle clock keeps running on the last
+    # known activity; the controller emits CullingProbeFailed)
+    out = default_probe(nb, None, base_url="http://127.0.0.1:9/api")
+    assert out is None
+
+
+def test_unreachable_probe_emits_warning_event(stack):
+    from kubeflow_rm_tpu.controlplane.controllers.culling import (
+        CullingController,
+    )
+
+    api, mgr, clock, jupyter = stack
+    api.create(make_notebook("nb", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+
+    ctrl = [c for c in mgr.controllers
+            if isinstance(c, CullingController)][0]
+    ctrl.probe_fn = lambda notebook, pod0: None
+    clock.advance(minutes=1)
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "nb", "u")
+    evs = [e for e in api.events_for(nb)
+           if e["reason"] == "CullingProbeFailed"]
+    assert len(evs) == 1
+    # re-reconciles do not spam the event
+    clock.advance(minutes=1)
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "nb", "u")
+    evs = [e for e in api.events_for(nb)
+           if e["reason"] == "CullingProbeFailed"]
+    assert len(evs) == 1

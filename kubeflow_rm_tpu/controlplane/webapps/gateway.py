@@ -52,7 +52,9 @@ def make_gateway(api, *, dev_user: str | None = None,
     header_key = "HTTP_" + USER_HEADER.upper().replace("-", "_")
 
     def with_identity(environ, start_response):
-        environ.setdefault(header_key, USER_PREFIX + dev_user)
+        # Overwrite unconditionally: dev_user pins the identity, so a
+        # client-supplied header must not be able to impersonate others.
+        environ[header_key] = USER_PREFIX + dev_user
         return gw(environ, start_response)
 
     return with_identity

@@ -24,7 +24,7 @@ from __future__ import annotations
 import copy
 
 import yaml
-from werkzeug.exceptions import BadRequest
+from werkzeug.exceptions import BadRequest, NotFound
 
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
 from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
@@ -328,14 +328,25 @@ def create_app(api: APIServer, *, config_path: str | None = None,
         jupyter/backend/apps/common/routes/get.py `get_pod_logs`."""
         app.ensure_authorized(req, "get", "notebooks", namespace)
         api.get(nb_api.KIND, name, namespace)  # 404 on unknown notebook
+        try:
+            ordinal = int(ordinal)
+        except ValueError:
+            raise BadRequest(f"pod ordinal must be an integer, "
+                             f"got {ordinal!r}")
         raw = req.args.get("tailLines")
         try:
             tail = int(raw) if raw is not None else None
         except ValueError:
             raise BadRequest(f"tailLines must be an integer, got {raw!r}")
+        pod_name = f"{name}-{ordinal}"
+        # The pod must belong to THIS notebook: a name-prefix match alone
+        # would let notebook 'a' read pods of notebook 'a-b'.
+        pod = api.try_get("Pod", pod_name, namespace)
+        if pod is None or (pod["metadata"].get("labels") or {}).get(
+                nb_api.NOTEBOOK_NAME_LABEL) != name:
+            raise NotFound(f"pod {pod_name} of notebook {name} not found")
         # kube semantics delegated to pod_logs: 0 -> nothing, <0 -> 4xx
-        text = api.pod_logs(namespace, f"{name}-{ordinal}",
-                            tail_lines=tail)
+        text = api.pod_logs(namespace, pod_name, tail_lines=tail)
         return {"logs": text.splitlines()}
 
     @app.route("/api/namespaces/<namespace>/notebooks", methods=("POST",))

@@ -46,7 +46,7 @@ def pipeline_forward(
     cfg: LlamaConfig,
     mesh: Mesh,
     *,
-    n_microbatches: int,
+    n_microbatches: int | None = None,
     positions: jax.Array | None = None,
     segments: jax.Array | None = None,
     packed: bool = False,
@@ -66,7 +66,9 @@ def pipeline_forward(
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={pp}")
     B, T = tokens.shape
-    M = n_microbatches
+    # None -> one microbatch per stage, the minimum that keeps every
+    # stage busy (same default make_train_step applies).
+    M = pp if n_microbatches is None else n_microbatches
     if B % M:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
     mb = B // M

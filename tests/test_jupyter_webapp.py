@@ -248,10 +248,32 @@ def test_pods_and_per_ordinal_logs(stack, app):
     resp = client.get("/api/namespaces/team/notebooks/mynb/pods/9/logs")
     assert resp.status_code == 404
 
+    # non-integer ordinal -> 400, not a pod-name join
+    resp = client.get("/api/namespaces/team/notebooks/mynb/pods/x/logs")
+    assert resp.status_code == 400
+
     # authz enforced
     resp = app.test_client(user="mallory@corp.com").get(
         "/api/namespaces/team/notebooks/mynb/pods/0/logs")
     assert resp.status_code == 403
+
+
+def test_pod_logs_require_notebook_ownership(stack, app):
+    """A pod that merely shares the '<notebook>-<ordinal>' name shape but
+    is not labelled as belonging to the notebook must not be readable
+    through its logs endpoint."""
+    api, mgr = stack
+    client = app.test_client(user=USER)
+    resp = post_json(client, "/api/namespaces/team/notebooks", spawn_body())
+    assert resp.status_code == 200
+    mgr.run_until_idle()
+
+    # an unrelated pod squatting on the name "mynb-7"
+    stray = make_object("v1", "Pod", "mynb-7", "team")
+    stray["spec"] = {"containers": [{"name": "x", "image": "busybox"}]}
+    api.create(stray)
+    resp = client.get("/api/namespaces/team/notebooks/mynb/pods/7/logs")
+    assert resp.status_code == 404
 
 
 def test_group_two_spawn_uses_rstudio_image(stack, app):

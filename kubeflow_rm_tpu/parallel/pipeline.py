@@ -78,8 +78,23 @@ def pipeline_forward(
     x, cos, sin, attn_positions, block = _prologue(
         params, tokens, cfg, positions, segments, packed)
 
+    # Interleaved fold: microbatch m takes rows m, M+m, 2M+m, ... so
+    # each device's contiguous block of batch rows lands one row in
+    # every microbatch. The (M, mb) layout then keeps M replicated and
+    # mb carrying the batch sharding with ZERO resharding traffic — a
+    # contiguous fold would split the batch axis across (M, mb), and
+    # dynamic_index_in_dim over a sharded M plus the scan-carry layout
+    # mismatch forces GSPMD into involuntary full rematerialization
+    # (replicate + repartition every tick).
+    batch_axes = ("dp", "fsdp")
+
     def fold(a):
-        return None if a is None else a.reshape(M, mb, *a.shape[1:])
+        if a is None:
+            return None
+        a = a.reshape(mb, M, *a.shape[1:]).swapaxes(0, 1)
+        spec = P(None, batch_axes, "sp", *([None] * (a.ndim - 3)))
+        return jax.lax.with_sharding_constraint(
+            a, jax.NamedSharding(mesh, spec))
 
     x_mb, cos_mb, sin_mb = fold(x), fold(cos), fold(sin)
     pos_mb, seg_mb = fold(attn_positions), fold(segments)
@@ -89,6 +104,22 @@ def pipeline_forward(
 
     def spmd(blocks, x_mb, cos_mb, sin_mb, pos_mb, seg_mb):
         stage = jax.lax.axis_index("pp")
+
+        # Pin the activation layout on the auto (non-pp) axes: batch
+        # rows over (dp, fsdp), sequence over sp, hidden replicated —
+        # the true-FSDP pattern (gathered weights, batch-sharded
+        # activations). Without this GSPMD may shard the scan carry on
+        # the hidden dim instead, which conflicts with the cotangent
+        # layout entering the backward scan and triggers involuntary
+        # full rematerialization.
+        # bare PartitionSpecs: inside the manual-pp region the ambient
+        # abstract mesh carries the axis types, so a NamedSharding over
+        # the outer (all-Auto) mesh would be rejected
+        act_spec = P(batch_axes, "sp", None)
+        outs_spec = P(None, batch_axes, "sp", None)
+
+        def pin(a):
+            return jax.lax.with_sharding_constraint(a, act_spec)
 
         def stage_apply(h, cos_t, sin_t, pos_t, seg_t):
             def body(h, layer):
@@ -106,9 +137,9 @@ def pipeline_forward(
             # stage s holds microbatch t - s; clamp keeps bubble ticks
             # on a valid (discarded) index instead of branching
             idx = jnp.clip(t - stage, 0, M - 1)
-            inp = jnp.where(stage == 0, pick(x_mb, idx), recv)
-            out = stage_apply(inp, pick(cos_mb, idx), pick(sin_mb, idx),
-                              pick(pos_mb, idx), pick(seg_mb, idx))
+            inp = pin(jnp.where(stage == 0, pick(x_mb, idx), recv))
+            out = pin(stage_apply(inp, pick(cos_mb, idx), pick(sin_mb, idx),
+                                  pick(pos_mb, idx), pick(seg_mb, idx)))
             recv_next = jax.lax.ppermute(
                 out, "pp", [(i, (i + 1) % pp) for i in range(pp)])
             # the last stage finishes microbatch t-(pp-1) at tick t
@@ -116,8 +147,10 @@ def pipeline_forward(
             keep = jnp.logical_and(stage == pp - 1, t >= pp - 1)
             cur = jax.lax.dynamic_index_in_dim(outputs, w, 0,
                                                keepdims=False)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs, jnp.where(keep, out, cur), w, 0)
+            outputs = jax.lax.with_sharding_constraint(
+                jax.lax.dynamic_update_index_in_dim(
+                    outputs, jnp.where(keep, out, cur), w, 0),
+                outs_spec)
             return (recv_next, outputs), None
 
         # the carry is stage-varying from tick 1 on; mark the initial
@@ -140,4 +173,6 @@ def pipeline_forward(
         axis_names={"pp"},
     )(params["blocks"], x_mb, cos_mb, sin_mb, pos_mb, seg_mb)
 
-    return _epilogue(params, h_mb.reshape(B, T, cfg.dim), cfg)
+    # inverse of the interleaved fold
+    return _epilogue(
+        params, h_mb.swapaxes(0, 1).reshape(B, T, cfg.dim), cfg)

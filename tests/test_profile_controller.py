@@ -103,6 +103,95 @@ def test_profile_delete_cascades_namespace(stack):
     assert api.try_get("ServiceAccount", "default-editor", "eve") is None
 
 
+def test_namespace_gets_istio_injection_label(stack):
+    """Profile namespaces run inside the mesh: sidecar injection is on
+    by default, and the label is re-asserted on a pre-existing
+    namespace (ref profile_controller.go:126-172, :181)."""
+    api, mgr = stack
+    # pre-existing namespace without the label (adopted profile)
+    api.ensure_namespace("iris")
+    api.create(make_profile("iris", "iris@corp.com"))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    ns = api.get("Namespace", "iris")
+    assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+
+    api.create(make_profile("ivan", "ivan@corp.com"))
+    mgr.run_until_idle()
+    ns = api.get("Namespace", "ivan")
+    assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+
+
+def test_owner_authorization_policy(stack):
+    """The owner gets the ns-owner-access-istio policy admitting them to
+    every workload in their namespace (ref profile_controller.go:419-557)
+    — without it the owner's own traffic is mesh-unauthorized."""
+    api, mgr = stack
+    api.create(make_profile("judy", "judy@corp.com"))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+
+    pol = api.get("AuthorizationPolicy", "ns-owner-access-istio", "judy")
+    assert pol["metadata"]["annotations"] == {"user": "judy@corp.com",
+                                             "role": "admin"}
+    rules = pol["spec"]["rules"]
+    # rule 1: the owner's identity header via the ingress gateway
+    assert rules[0]["when"][0]["key"] == "request.headers[kubeflow-userid]"
+    assert rules[0]["when"][0]["values"] == [":judy@corp.com"]
+    # rule 2: same-namespace traffic (slice rendezvous)
+    assert {"key": "source.namespace", "values": ["judy"]} \
+        in rules[1]["when"]
+    # rule 4: the culler's kernel-activity probe
+    assert rules[3]["to"][0]["operation"]["paths"] == ["*/api/kernels"]
+
+    # owner change propagates into the policy (reconcile, not create-once)
+    prof = api.get("Profile", "judy")
+    prof["spec"]["owner"]["name"] = "judy2@corp.com"
+    api.update(prof)
+    mgr.run_until_idle()
+    pol = api.get("AuthorizationPolicy", "ns-owner-access-istio", "judy")
+    assert pol["spec"]["rules"][0]["when"][0]["values"] \
+        == [":judy2@corp.com"]
+
+
+def test_finalizer_revokes_plugins_on_delete(stack):
+    """Deletion runs plugin.revoke behind the profile-finalizer before
+    the object goes away (ref profile_controller.go:297-331): the
+    Workload Identity annotation must be stripped from the editor SA."""
+    api, mgr = stack
+    api.create(make_profile(
+        "kate", "kate@corp.com",
+        plugins=[{"kind": "WorkloadIdentity",
+                  "spec": {"gcpServiceAccount":
+                           "train@proj.iam.gserviceaccount.com"}}]))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    prof = api.get("Profile", "kate")
+    assert "profile-finalizer" in prof["metadata"]["finalizers"]
+    sa = api.get("ServiceAccount", "default-editor", "kate")
+    assert "iam.gke.io/gcp-service-account" in sa["metadata"]["annotations"]
+
+    revoked = []
+    from kubeflow_rm_tpu.controlplane.controllers import profile as mod
+    orig = mod.GcpWorkloadIdentityPlugin.revoke
+
+    def spy(self, api_, profile_, spec_):
+        revoked.append(profile_["metadata"]["name"])
+        return orig(self, api_, profile_, spec_)
+
+    mod.GcpWorkloadIdentityPlugin.revoke = spy
+    try:
+        api.delete("Profile", "kate")
+        mgr.run_until_idle()
+    finally:
+        mod.GcpWorkloadIdentityPlugin.revoke = orig
+
+    assert revoked == ["kate"]
+    # finalizer released -> profile finalized; namespace goes via GC
+    assert api.try_get("Profile", "kate") is None
+    assert api.try_get("Namespace", "kate") is None
+
+
 def test_workload_identity_plugin_annotates_editor_sa(stack):
     api, mgr = stack
     api.create(make_profile(

@@ -70,10 +70,18 @@ def make_tpu_node(name: str, accelerator_type: str) -> dict:
 class StatefulSetController(Controller):
     kind = "StatefulSet"
 
-    def __init__(self, auto_ready: bool = True):
+    def __init__(self, auto_ready: bool = True,
+                 virtual_node_fallback: bool | None = None):
         # auto_ready=False leaves scheduled pods un-Ready so tests can
-        # exercise status ladders and slice-health timing
+        # exercise status ladders and slice-health timing.
+        # virtual_node_fallback: place selector-less CPU pods on a
+        # synthetic node when no Node inventory exists. None (default)
+        # resolves per-backend: allowed against the hermetic in-memory
+        # APIServer, refused against a KubeAPIServer — there an empty
+        # node list is a real "no nodes at all" condition that must
+        # surface as FailedScheduling, not be papered over.
         self.auto_ready = auto_ready
+        self.virtual_node_fallback = virtual_node_fallback
 
     def watches(self):
         return (("Pod", map_to_owner("StatefulSet")),)
@@ -148,7 +156,9 @@ class StatefulSetController(Controller):
         """Would creating every missing ordinal clear the namespace's
         ResourceQuotas? Mirrors the apiserver's per-pod enforcement
         (``apiserver._enforce_quota``) summed over the whole batch."""
-        if not api.quota_enforcement:
+        # KubeAPIServer has no client-side toggle: quota admission is
+        # the server's job there, this pre-check stays advisory
+        if not getattr(api, "quota_enforcement", True):
             return True
         ns = namespace_of(sts)
         quotas = api.list("ResourceQuota", ns)
@@ -230,7 +240,7 @@ class StatefulSetController(Controller):
                         and deep_get(pod, "status", "phase") != "Running"):
                     self.mark_running(api, pod)
                 continue
-            node = self._pick_node(pod, nodes, used)
+            node = self._pick_node(api, pod, nodes, used)
             if node is None:
                 if deep_get(pod, "status", "phase") != "Pending":
                     pod["status"] = {"phase": "Pending"}
@@ -289,7 +299,7 @@ class StatefulSetController(Controller):
                 f"hostnames={env.get('TPU_WORKER_HOSTNAMES', '')} "
                 "joining jax.distributed")
 
-    def _pick_node(self, pod: dict, nodes: list[dict],
+    def _pick_node(self, api: APIServer, pod: dict, nodes: list[dict],
                    used: dict[str, float]):
         selector = deep_get(pod, "spec", "nodeSelector", default={}) or {}
         need = _pod_tpu_request(pod)
@@ -304,7 +314,10 @@ class StatefulSetController(Controller):
                 if used.get(name_of(node), 0.0) + need > cap:
                     continue
             return node
-        if not selector and not need:
+        allow_virtual = (self.virtual_node_fallback
+                         if self.virtual_node_fallback is not None
+                         else isinstance(api, APIServer))
+        if allow_virtual and not selector and not need:
             # plain CPU pod: runnable even in a test with no Node inventory
             return {"metadata": {"name": "virtual-node"}}
         return None

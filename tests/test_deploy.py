@@ -208,6 +208,37 @@ def cluster():
     rest.stop()
 
 
+def test_no_virtual_node_fallback_against_kube_adapter(cluster):
+    """Against a KubeAPIServer an empty node list is a real 'no nodes
+    at all' condition: a selector-less CPU pod must stay Pending with
+    FailedScheduling, not land on the hermetic virtual node (VERDICT r3
+    weak-#6). The in-memory backend keeps the fallback."""
+    from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+        DeploymentController,
+    )
+    from kubeflow_rm_tpu.controlplane.runtime import Manager
+
+    api, kapi = cluster
+    for backend, expect_phase in ((kapi, "Pending"), (api, "Running")):
+        name = f"cpu-{expect_phase.lower()}"
+        mgr = Manager(backend)
+        mgr.add(DeploymentController(auto_ready=True))
+        deploy = make_object("apps/v1", "Deployment", name, "u")
+        deploy["spec"] = {
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "web", "image": "dash:latest"}]}},
+        }
+        backend.create(deploy)
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        pod = backend.get("Pod", f"{name}-0", "u")
+        assert deep_get(pod, "status", "phase") == expect_phase, backend
+        if expect_phase == "Pending":
+            assert any(e["reason"] == "FailedScheduling"
+                       for e in backend.events_for(pod))
+
+
 def test_kubeclient_verb_surface_roundtrip(cluster):
     _, kapi = cluster
     cm = make_object("v1", "ConfigMap", "c", "u")

@@ -147,49 +147,55 @@ def wallclock_main(args) -> int:
     else:
         raise AssertionError("profile never reconciled over the wire")
 
-    session = requests.Session()
-    token = secrets.token_urlsafe(16)
-    session.cookies.set(CSRF_COOKIE, token)
-    session.headers[CSRF_HEADER] = token
-    session.headers[USER_HEADER] = USER_PREFIX + USER
+    def spawn_one(i: int) -> float:
+        """POST the spawn form, poll the web API until the slice is
+        fully ready (what the SPA's status ladder does); returns the
+        provision wall time. Each worker carries its own Session —
+        requests Sessions are not thread-safe."""
+        s = requests.Session()
+        tok = secrets.token_urlsafe(16)
+        s.cookies.set(CSRF_COOKIE, tok)
+        s.headers[CSRF_HEADER] = tok
+        s.headers[USER_HEADER] = USER_PREFIX + USER
+        body = {
+            "name": f"wc-{i}",
+            "image": "ghcr.io/kubeflow-rm-tpu/jupyter-jax:latest",
+            "imagePullPolicy": "IfNotPresent",
+            "serverType": "jupyter", "cpu": "2", "memory": "8Gi",
+            "tpu": {"acceleratorType": accel},
+            "tolerationGroup": "none", "affinityConfig": "none",
+            "configurations": [], "shm": True, "environment": {},
+            "datavols": [],
+        }
+        t0 = time.perf_counter()
+        resp = s.post(
+            f"{jwa_url}/api/namespaces/conformance/notebooks", json=body)
+        assert resp.status_code == 200, resp.text
+        slice_deadline = time.monotonic() + 120
+        while True:
+            # the list endpoint serves summaries without replica
+            # counts; the per-notebook GET returns the raw CR
+            resp = s.get(
+                f"{jwa_url}/api/namespaces/conformance/notebooks/wc-{i}")
+            nb = resp.json().get("notebook", {}) \
+                if resp.status_code == 200 else {}
+            if (nb.get("status") or {}).get(
+                    "readyReplicas") == topo.hosts:
+                return time.perf_counter() - t0
+            if time.monotonic() > slice_deadline:
+                raise AssertionError(
+                    f"wc-{i} never ready: {nb.get('status')}")
+            # scale the poll with the worker count: N pollers at 20ms
+            # would mostly measure their own GIL pressure
+            time.sleep(0.02 * max(1, args.concurrency))
 
-    latencies = []
     t_start = time.perf_counter()
     try:
-        for i in range(args.notebooks):
-            body = {
-                "name": f"wc-{i}",
-                "image": "ghcr.io/kubeflow-rm-tpu/jupyter-jax:latest",
-                "imagePullPolicy": "IfNotPresent",
-                "serverType": "jupyter", "cpu": "2", "memory": "8Gi",
-                "tpu": {"acceleratorType": accel},
-                "tolerationGroup": "none", "affinityConfig": "none",
-                "configurations": [], "shm": True, "environment": {},
-                "datavols": [],
-            }
-            t0 = time.perf_counter()
-            resp = session.post(
-                f"{jwa_url}/api/namespaces/conformance/notebooks",
-                json=body)
-            assert resp.status_code == 200, resp.text
-            # poll the web API until the slice is fully ready (what the
-            # SPA's status ladder does)
-            slice_deadline = time.monotonic() + 60
-            while True:
-                # the list endpoint serves summaries without replica
-                # counts; the per-notebook GET returns the raw CR
-                resp = session.get(
-                    f"{jwa_url}/api/namespaces/conformance/notebooks/wc-{i}")
-                nb = resp.json().get("notebook", {}) \
-                    if resp.status_code == 200 else {}
-                if (nb.get("status") or {}).get(
-                        "readyReplicas") == topo.hosts:
-                    break
-                if time.monotonic() > slice_deadline:
-                    raise AssertionError(
-                        f"wc-{i} never ready: {nb.get('status')}")
-                time.sleep(0.02)
-            latencies.append(time.perf_counter() - t0)
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = max(1, args.concurrency)
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            latencies = list(pool.map(spawn_one, range(args.notebooks)))
     finally:
         stop.set()
         httpd.shutdown()
@@ -197,9 +203,10 @@ def wallclock_main(args) -> int:
 
     total = time.perf_counter() - t_start
     lat_sorted = sorted(latencies)
-    print(json.dumps({
+    result = {
         "mode": "wallclock",
         "notebooks": args.notebooks,
+        "concurrency": workers,
         "slice": accel,
         "hosts_per_slice": topo.hosts,
         "provision_p50_ms": round(lat_sorted[len(latencies) // 2] * 1e3,
@@ -207,7 +214,11 @@ def wallclock_main(args) -> int:
         "provision_p95_ms": round(
             lat_sorted[max(0, int(len(latencies) * 0.95) - 1)] * 1e3, 1),
         "total_s": round(total, 2),
-    }))
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
     print("CONFORMANCE OK (wallclock)")
     return 0
 
@@ -219,6 +230,12 @@ def main() -> int:
     ap.add_argument("--notebooks", type=int, default=3)
     ap.add_argument("--wallclock", action="store_true",
                     help="real sockets + watch threads; wall-time p50")
+    ap.add_argument("--concurrency", type=int, default=1,
+                    help="parallel spawn workers (wallclock mode): the "
+                         "load shape that flushes watch/queue races")
+    ap.add_argument("--out", default="",
+                    help="also write the result JSON to this file "
+                         "(PROVISION_r{N}.json artifact)")
     args = ap.parse_args()
     if args.wallclock:
         return wallclock_main(args)

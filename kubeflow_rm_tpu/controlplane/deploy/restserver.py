@@ -327,6 +327,11 @@ class RestServer:
 
         class H(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # status/headers and body go out as separate small send()s;
+            # with Nagle on, the second write stalls ~40ms behind the
+            # client's delayed ACK — which would dominate every
+            # provision-latency number this server exists to measure
+            disable_nagle_algorithm = True
 
             def _go(self):
                 outer._handle(self)

@@ -90,6 +90,9 @@ class Manager:
         self._timed: list[tuple[datetime.datetime, str, Request]] = []
         self._retries: dict[tuple[str, Request], int] = {}
         self.errors: list[tuple[str, Request, Exception]] = []
+        # run_forever blocks on this between drains; enqueue sets it so
+        # watch events are served at HTTP latency, not poll latency
+        self._wake = threading.Event()
         api.add_watcher(self._on_event)
 
     def add(self, controller: Controller) -> None:
@@ -102,6 +105,7 @@ class Manager:
         name = controller if isinstance(controller, str) else controller.name
         with self._queue_lock:
             self._queues[name].add(req)
+        self._wake.set()
 
     def enqueue_all(self) -> None:
         """Seed every controller's queue with all existing primaries
@@ -175,6 +179,7 @@ class Manager:
         stop = stop or threading.Event()
         logger = logging.getLogger("kubeflow_rm_tpu.manager")
         while not stop.is_set():
+            self._wake.clear()
             try:
                 self.run_until_idle()
             except RuntimeError as e:
@@ -186,7 +191,9 @@ class Manager:
                     logger.error("%s %s gave up after retries: %s",
                                  cname, req, err)
             self.errors.clear()
-            stop.wait(poll_interval_s)
+            # woken immediately by enqueue; the timeout only bounds how
+            # late a timed requeue (or stop) can fire
+            self._wake.wait(poll_interval_s)
 
     def _retry(self, c: Controller, req: Request, e: Exception) -> None:
         from kubeflow_rm_tpu.controlplane import metrics

@@ -1,0 +1,106 @@
+"""torch_xla (PJRT) bootstrap from the platform's rendezvous contract.
+
+The webhook injects the same env into every pod regardless of framework
+(``controlplane/webhook/tpu_inject.py``): ``TPU_WORKER_ID``,
+``TPU_WORKER_HOSTNAMES``, ``TPU_ACCELERATOR_TYPE``, ``TPU_TOPOLOGY``
+(+ ``MEGASCALE_*`` on multislice). jax consumes it via
+``parallel.distributed``; this module is the torch_xla consumer, used
+by the ``jupyter-pytorch-xla`` image (BASELINE.md eval config
+"torch_xla v5litepod-4"; reference seam:
+``example-notebook-servers/jupyter-pytorch-cuda/Dockerfile:14-23``,
+whose NVIDIA_* env plays the role PJRT_DEVICE plays here).
+
+Two layers, mirroring how torch_xla actually rendezvouses:
+
+- **libtpu layer**: ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` are
+  read by libtpu itself for ICI rendezvous — torch_xla's PJRT client
+  consumes them exactly as jax's does, so the webhook's contract needs
+  no translation there.
+- **torch.distributed layer**: collectives through
+  ``torch.distributed`` need a process group; ``pjrt://`` handles the
+  single-host case, while multi-host needs MASTER_ADDR/MASTER_PORT +
+  rank/world. ``torchxla_env`` derives those from the same contract
+  (worker 0 is the master — pod ordinals are stable because the slice
+  is a StatefulSet behind a headless Service).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeflow_rm_tpu.parallel.distributed import TpuEnv, tpu_env
+
+#: the conventional torch.distributed master port (init_method env://)
+DEFAULT_MASTER_PORT = 12355
+
+
+def torchxla_env(environ=None, *, master_port: int = DEFAULT_MASTER_PORT,
+                 device: str = "TPU") -> dict[str, str]:
+    """Map the webhook contract to the env a torch_xla process needs.
+
+    Returns the variables to merge into the process environment before
+    ``import torch_xla`` (PJRT reads them at client construction):
+
+    - ``PJRT_DEVICE`` — selects the TPU PJRT plugin (or CPU in tests);
+    - ``MASTER_ADDR``/``MASTER_PORT``/``RANK``/``WORLD_SIZE``/
+      ``LOCAL_RANK`` — the torch.distributed env:// rendezvous, derived
+      slice-major exactly like the jax process ids so a hybrid job
+      numbers both worlds identically.
+
+    Raises ``ValueError`` on a contract violation (ordinal outside the
+    slice) — the platform injecting inconsistent env is a bug worth
+    failing loudly on, not a condition to limp through.
+    """
+    env: TpuEnv = tpu_env(environ)
+    if env.worker_hostnames and env.worker_id >= env.hosts_per_slice:
+        raise ValueError(
+            f"TPU_WORKER_ID={env.worker_id} outside the "
+            f"{env.hosts_per_slice}-host slice "
+            f"(TPU_WORKER_HOSTNAMES={','.join(env.worker_hostnames)})")
+    master = env.worker_hostnames[0] if env.worker_hostnames else "localhost"
+    if env.is_multislice and env.coordinator:
+        master = env.coordinator.split(":")[0]
+    return {
+        "PJRT_DEVICE": device,
+        "MASTER_ADDR": master,
+        "MASTER_PORT": str(master_port),
+        "RANK": str(env.process_id),
+        "LOCAL_RANK": "0",
+        "WORLD_SIZE": str(env.num_hosts),
+    }
+
+
+def apply_env(environ=None, **kw) -> dict[str, str]:
+    """Merge ``torchxla_env`` into ``os.environ`` (idempotent; explicit
+    user overrides win). Returns the mapping that was applied."""
+    mapping = torchxla_env(environ, **kw)
+    for k, v in mapping.items():
+        os.environ.setdefault(k, v)
+    return mapping
+
+
+def init_distributed(environ=None, *, backend: str | None = None,
+                     master_port: int = DEFAULT_MASTER_PORT,
+                     device: str = "TPU"):
+    """Initialize ``torch.distributed`` from the platform contract.
+
+    On a TPU image the backend is ``xla`` (torch_xla registers it on
+    import); tests pass ``backend="gloo"`` to prove the same rendezvous
+    env drives a real process-group init without TPU hardware. No-op
+    returning None when torch.distributed is already initialized.
+    """
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return None
+    mapping = apply_env(environ, master_port=master_port, device=device)
+    if backend is None:
+        import torch_xla  # noqa: F401  (registers the xla backend)
+        backend = "xla"
+    dist.init_process_group(
+        backend,
+        init_method="env://",
+        rank=int(mapping["RANK"]),
+        world_size=int(mapping["WORLD_SIZE"]),
+    )
+    return dist

@@ -10,10 +10,12 @@ from pathlib import Path
 import pytest
 
 IMAGES = Path(__file__).resolve().parent.parent / "images"
-TPU_IMAGES = ("jupyter-jax", "jupyter-jax-full", "jupyter-pytorch-xla")
+TPU_IMAGES = ("jupyter-jax", "jupyter-jax-full", "jupyter-pytorch-xla",
+              "jupyter-tensorflow")
 ALL_IMAGES = ("base", "jupyter", "jupyter-jax", "jupyter-jax-full",
-              "jupyter-pytorch-xla", "jupyter-scipy", "codeserver",
-              "codeserver-python", "rstudio", "rstudio-tidyverse")
+              "jupyter-pytorch-xla", "jupyter-tensorflow",
+              "jupyter-scipy", "codeserver", "codeserver-python",
+              "rstudio", "rstudio-tidyverse")
 
 
 def test_every_image_dir_has_parameterized_dockerfile():
@@ -61,6 +63,41 @@ def test_multihost_service_split():
     agent = (IMAGES / "jupyter-jax" /
              "s6/services.d/worker-agent/run").read_text()
     assert "kubeflow_rm_tpu.launcher.agent" in agent
+
+
+def test_pytorch_xla_image_contract():
+    """The torch image consumes the SAME webhook contract as jax: PJRT
+    device selection plus the launcher.torchxla mapper baked in — and
+    documents its single-host interactive scope (multi-controller torch
+    has no notebook-kernel stand-in for ordinals > 0)."""
+    df = (IMAGES / "jupyter-pytorch-xla" / "Dockerfile").read_text()
+    assert "PJRT_DEVICE=TPU" in df
+    assert "torch_xla[tpu]" in df
+    assert "kubeflow_rm_tpu/" in df  # launcher.torchxla available in-image
+    assert "single-host" in df
+    # the Makefile stages the library into the build context
+    mk = (IMAGES / "Makefile").read_text()
+    assert "cp -r ../kubeflow_rm_tpu jupyter-pytorch-xla/" in mk
+
+
+def test_tensorflow_image_contract():
+    """Parity row for the reference's jupyter-tensorflow
+    (example-notebook-servers/README.md:11-33): TF rides PJRT/libtpu,
+    attaches locally (TPU_NAME=local), no CUDA."""
+    df = (IMAGES / "jupyter-tensorflow" / "Dockerfile").read_text()
+    assert "tensorflow==" in df
+    assert "libtpu" in df
+    assert "TPU_NAME=local" in df
+
+
+def test_framework_scope_documented_in_readme():
+    """No silent gaps: the README carries the reference parity table and
+    the per-framework multi-host scope decision (VERDICT r3 #7)."""
+    readme = (IMAGES / "README.md").read_text()
+    assert "Parity vs the reference image tree" in readme
+    for row in ("jupyter-tensorflow", "jupyter-pytorch-xla",
+                "torchrun", "Multi-host scope"):
+        assert row in readme
 
 
 def test_makefile_covers_every_image_with_correct_parents():

@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Browser-grade SPA e2e: spawn → ready → logs → stop → delete.
+
+The regex contract check (tests/test_frontend.py) proves app.js calls
+routes that exist; THIS harness proves a real DOM executes it (VERDICT
+r3 #6; reference counterpart:
+``crud-web-apps/jupyter/frontend/cypress/e2e/main-page.cy.ts``).
+
+Two modes:
+
+- default — drive the flow with playwright (the CI lane installs it;
+  see ``.github/workflows/browser_e2e.yaml``), exit nonzero on any
+  broken route or render;
+- ``--serve`` — boot the same stack and block, printing the URL, so
+  any real browser (or an agentic webview) can drive it manually.
+
+The stack is the dev/e2e layout: in-memory cluster + admission chain +
+fake kubelet + platform controllers on one manager thread, the
+single-origin gateway (dashboard SPA + every web app) served by
+werkzeug, ``dev_user`` standing in for the mesh auth proxy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+USER = "e2e@corp.com"
+NS = "e2e"
+ACCEL = "v5p-16"
+
+
+def serve_stack(port: int = 0):
+    """Boot cluster + controllers + gateway; returns (url, stop_fn)."""
+    from werkzeug.serving import make_server
+
+    from kubeflow_rm_tpu.controlplane import make_control_plane
+    from kubeflow_rm_tpu.controlplane.api.profile import make_profile
+    from kubeflow_rm_tpu.controlplane.api.tpu import lookup
+    from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+        make_tpu_node,
+    )
+    from kubeflow_rm_tpu.controlplane.webapps.gateway import make_gateway
+
+    api, mgr = make_control_plane()
+    for h in range(lookup(ACCEL).hosts):
+        api.create(make_tpu_node(f"{ACCEL}-h{h}", ACCEL))
+    api.create(make_profile(NS, USER))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+
+    stop = threading.Event()
+    threading.Thread(target=mgr.run_forever, args=(stop, 0.05),
+                     daemon=True).start()
+
+    gw = make_gateway(api, dev_user=USER, secure_cookies=False)
+    httpd = make_server("127.0.0.1", port, gw, threaded=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    def shutdown():
+        stop.set()
+        httpd.shutdown()
+
+    return f"http://127.0.0.1:{httpd.server_port}", shutdown
+
+
+def drive(url: str, headed: bool = False) -> None:
+    """The e2e itself. Raises on any failed expectation."""
+    from playwright.sync_api import expect, sync_playwright
+
+    nb = "e2e-nb"
+    with sync_playwright() as pw:
+        browser = pw.chromium.launch(headless=not headed)
+        page = browser.new_page()
+        page.on("dialog", lambda d: d.accept())  # the delete confirm()
+
+        # home: fleet metrics render from /api/metrics
+        page.goto(url)
+        expect(page.locator("#view .pill").first).to_contain_text(
+            "TPU nodes")
+
+        # spawner: name + slice chip + launch
+        page.goto(f"{url}/#/notebooks/new")
+        page.fill("#f-name", nb)
+        page.click(f'.slice-chip[data-accel="{ACCEL}"]')
+        page.click('#spawn button[type="submit"]')
+
+        # table: the row walks the status ladder to ready
+        expect(page.locator(f'tr[data-name="{nb}"]')).to_be_visible()
+        expect(page.locator(f'tr[data-name="{nb}"] .status')
+               ).to_contain_text("ready", timeout=30_000)
+
+        # detail: per-ordinal pod logs carry the rendezvous transcript
+        page.click(f'tr[data-name="{nb}"] td:nth-child(2)')
+        expect(page.locator("#d-pods button[data-pod]")).to_have_count(2)
+        page.click('#d-pods button[data-pod="1"]')
+        expect(page.locator("#d-logs")).to_contain_text(
+            "TPU_WORKER_ID=1", timeout=10_000)
+        expect(page.locator("#d-logs")).to_contain_text(
+            "joining jax.distributed")
+
+        # stop: phase flips to stopped (culling path's UI affordance)
+        page.goto(f"{url}/#/notebooks")
+        page.click(f'tr[data-name="{nb}"] button[data-act="stop"]')
+        expect(page.locator(f'tr[data-name="{nb}"] .status')
+               ).to_contain_text("stopped", timeout=30_000)
+
+        # delete: row disappears (confirm() auto-accepted above)
+        page.click(f'tr[data-name="{nb}"] button[data-act="delete"]')
+        expect(page.locator(f'tr[data-name="{nb}"]')
+               ).to_have_count(0, timeout=30_000)
+
+        browser.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true",
+                    help="boot the stack and block (manual driving)")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--headed", action="store_true")
+    args = ap.parse_args()
+
+    url, shutdown = serve_stack(args.port)
+    print(f"gateway: {url}  (user: {USER}, namespace: {NS})", flush=True)
+    if args.serve:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            shutdown()
+        return 0
+
+    try:
+        drive(url, headed=args.headed)
+    finally:
+        shutdown()
+    print("BROWSER E2E OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

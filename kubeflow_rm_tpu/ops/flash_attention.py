@@ -49,8 +49,12 @@ NEG_INF = -2.0**30
 # bigger MXU panels beat finer-grained causal skipping. ``pick_block``
 # degrades to the largest divisor of T so sequence lengths that are
 # multiples of 128 but not 1024 (1280, 1536, ...) stay on the kernel.
-DEFAULT_BLOCK_Q = 1024
-DEFAULT_BLOCK_K = 1024
+import os
+
+# KFRM_FLASH_BLOCK overrides both defaults — the bench sweep's knob
+# (testing/mfu_sweep notes); code callers pass block_q/block_k.
+DEFAULT_BLOCK_Q = int(os.environ.get("KFRM_FLASH_BLOCK", 1024))
+DEFAULT_BLOCK_K = int(os.environ.get("KFRM_FLASH_BLOCK", 1024))
 
 
 def pick_block(preferred: int, T: int) -> int:

@@ -113,7 +113,8 @@ def loss_fn(params, batch, cfg: TrainConfig,
 
 def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
                     batch_keys: tuple = ("tokens", "labels"),
-                    n_microbatches: int | None = None) -> Callable:
+                    n_microbatches: int | None = None,
+                    grad_accum: int = 1) -> Callable:
     """Return jitted ``step(state, batch) -> (state, metrics)``.
 
     ``batch`` maps each of ``batch_keys`` to a (B, T) int32 array laid
@@ -124,6 +125,15 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
     On a mesh with pp > 1 the forward runs the GPipe schedule
     (``parallel.pipeline``); ``n_microbatches`` (default: pp) sets the
     bubble fraction (pp-1)/(n_microbatches+pp-1).
+
+    ``grad_accum`` > 1 splits the global batch into that many
+    sequential microbatches under ``lax.scan``, accumulating gradients
+    before ONE optimizer update. Two reasons to use it: effective batch
+    beyond what HBM fits, and amortizing the optimizer update — on a
+    ~1B-param single chip the adam step is pure HBM traffic worth a
+    double-digit share of step time, and accumulation divides it by K.
+    The per-step loss/grads equal the full-batch computation up to
+    accumulation-order rounding (asserted by tests/test_train.py).
     """
     if mesh.shape.get("pp", 1) > 1 and n_microbatches is None:
         n_microbatches = mesh.shape["pp"]
@@ -131,10 +141,45 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
     sshard = state_shardings(cfg, state, mesh)
     bshard = {k: NamedSharding(mesh, batch_pspec()) for k in batch_keys}
     mshard = NamedSharding(mesh, P())
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def fold(a):
+        # interleaved: microbatch m takes rows m, K+m, ... so the fold
+        # keeps K replicated and the microbatch dim on the batch
+        # sharding with zero resharding traffic (same reasoning as
+        # parallel.pipeline's fold)
+        if a.shape[0] % grad_accum:
+            raise ValueError(
+                f"batch {a.shape[0]} not divisible by "
+                f"grad_accum={grad_accum}")
+        mb = a.shape[0] // grad_accum
+        a = a.reshape(mb, grad_accum, *a.shape[1:]).swapaxes(0, 1)
+        spec = P(None, *batch_pspec())
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, spec))
+
+    def accumulate(params, batch):
+        folded = {k: fold(v) for k, v in batch.items()}
+
+        def body(acc, mbatch):
+            (loss, aux), g = grad_fn(params, mbatch, cfg, mesh,
+                                     n_microbatches)
+            return jax.tree_util.tree_map(jnp.add, acc, g), (loss, aux)
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), params)
+        summed, (losses, auxes) = jax.lax.scan(body, zeros, folded)
+        grads = jax.tree_util.tree_map(lambda g: g / grad_accum, summed)
+        loss = jnp.mean(losses)
+        aux = jax.tree_util.tree_map(lambda a: jnp.mean(a, axis=0), auxes)
+        return (loss, aux), grads
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, cfg, mesh, n_microbatches)
+        if grad_accum > 1:
+            (loss, aux), grads = accumulate(state.params, batch)
+        else:
+            (loss, aux), grads = grad_fn(
+                state.params, batch, cfg, mesh, n_microbatches)
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)

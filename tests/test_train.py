@@ -66,6 +66,39 @@ def test_train_determinism(tiny_cfg, devices8):
     assert run() == pytest.approx(run(), abs=1e-6)
 
 
+def test_grad_accum_matches_full_batch(tiny_cfg, devices8):
+    """K sequential microbatches + one optimizer update must equal the
+    full-batch step (same loss, same resulting params) up to
+    accumulation-order rounding — the contract that makes grad_accum a
+    pure memory/HBM knob, not a hyperparameter change."""
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2), devices8)
+    batch = next(synthetic_batches(8, 32, tiny_cfg.model.vocab_size))
+
+    def run(k):
+        state = init_train_state(tiny_cfg, jax.random.key(0))
+        step = make_train_step(tiny_cfg, mesh, state, grad_accum=k)
+        state, m = step(state, shard_batch(batch, mesh))
+        return float(m["loss"]), float(m["grad_norm"]), state.params
+
+    loss1, gnorm1, params1 = run(1)
+    loss4, gnorm4, params4 = run(4)
+    assert loss4 == pytest.approx(loss1, rel=1e-5)
+    assert gnorm4 == pytest.approx(gnorm1, rel=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(params1),
+                    jax.tree_util.tree_leaves(params4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_grad_accum_rejects_indivisible_batch(tiny_cfg, devices8):
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2), devices8)
+    state = init_train_state(tiny_cfg, jax.random.key(0))
+    step = make_train_step(tiny_cfg, mesh, state, grad_accum=3)
+    batch = next(synthetic_batches(8, 32, tiny_cfg.model.vocab_size))
+    with pytest.raises(ValueError, match="grad_accum"):
+        step(state, shard_batch(batch, mesh))
+
+
 def test_pack_documents():
     docs = [[1, 2, 3, 4, 5], [6, 7, 8], [9, 10]]
     out = pack_documents(docs, seq_len=4)

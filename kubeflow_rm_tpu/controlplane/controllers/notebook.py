@@ -118,10 +118,14 @@ class NotebookController(Controller):
             c0 = containers[0]
             env = c0.setdefault("env", [])
             _upsert_env(env, "NB_PREFIX", f"/notebook/{ns}/{name}")
-        pod_labels = {
+        # CR labels flow onto the pods (ref notebook_controller.go:441-443)
+        # — the hook PodDefault selectors match on (JWA "configurations"
+        # writes label keys to the Notebook metadata); ours win on clash
+        pod_labels = dict(notebook["metadata"].get("labels") or {})
+        pod_labels.update({
             "statefulset": name,
             nb_api.NOTEBOOK_NAME_LABEL: name,
-        }
+        })
         pod_annotations = {}
         if topo:
             pod_labels[nb_api.TPU_ACCELERATOR_LABEL] = topo.accelerator_type

@@ -258,11 +258,15 @@ route(/^\/notebooks$/, async () => {
 
 route(/^\/notebooks\/new$/, async () => {
   const ns = state.namespace;
-  const [cfgData, tpuData] = await Promise.all([
+  const [cfgData, tpuData, pdData, pvcData] = await Promise.all([
     get("/jupyter/api/config"),
     get("/jupyter/api/tpus"),
+    get(`/jupyter/api/namespaces/${ns}/poddefaults`).catch(() => ({ poddefaults: [] })),
+    get(`/jupyter/api/namespaces/${ns}/pvcs`).catch(() => ({ pvcs: [] })),
   ]);
   const cfg = cfgData.config || {};
+  const poddefaults = pdData.poddefaults || [];
+  const existingPvcs = (pvcData.pvcs || []).map((p) => p.metadata.name);
   const field = (k) => cfg[k] || {};
   const ro = (k) => (field(k).readOnly ? "disabled" : "");
   // per-server-type image field (backend set_image contract)
@@ -324,12 +328,91 @@ route(/^\/notebooks\/new$/, async () => {
           <label><input type="checkbox" id="f-workspace" checked>
             Create a workspace volume (5Gi, mounted at /home/jovyan)</label>
         </div>
+        <details class="field">
+          <summary>Advanced options</summary>
+          ${poddefaults.length ? `
+          <div class="field">
+            <label>Configurations (PodDefaults)</label>
+            ${poddefaults.map((pd) => {
+              const key = Object.keys(pd.label || {})[0];
+              return key ? `<label class="inline">
+                <input type="checkbox" class="f-poddefault"
+                       value="${esc(key)}"> ${esc(pd.desc)}</label>` : "";
+            }).join("")}
+          </div>` : ""}
+          <div class="field">
+            <label for="f-datavols">Data volumes</label>
+            <div id="f-datavols"></div>
+            <button type="button" class="btn" id="f-addvol">+ Attach volume</button>
+            <p class="hint">Mount an existing PVC or create a new one per row.</p>
+          </div>
+          <div class="grid2">
+            <div class="field">
+              <label for="f-tolerations">Tolerations</label>
+              <select id="f-tolerations" ${ro("tolerationGroup")}>
+                ${(field("tolerationGroup").options || [{ groupKey: "none", displayName: "No toleration" }])
+                  .map((g) => `<option value="${esc(g.groupKey)}"
+                    ${g.groupKey === field("tolerationGroup").value ? "selected" : ""}>
+                    ${esc(g.displayName || g.groupKey)}</option>`).join("")}
+              </select>
+            </div>
+            <div class="field">
+              <label for="f-affinity">Affinity</label>
+              <select id="f-affinity" ${ro("affinityConfig")}>
+                <option value="none">none</option>
+                ${(field("affinityConfig").options || [])
+                  .map((a) => `<option value="${esc(a.configKey)}"
+                    ${a.configKey === field("affinityConfig").value ? "selected" : ""}>
+                    ${esc(a.displayName || a.configKey)}</option>`).join("")}
+              </select>
+            </div>
+          </div>
+          <div class="field">
+            <label for="f-env">Environment variables (KEY=VALUE, one per line)</label>
+            <textarea id="f-env" rows="3" placeholder="HF_HOME=/home/jovyan/.cache"></textarea>
+          </div>
+          <div class="field">
+            <label><input type="checkbox" id="f-shm"
+              ${field("shm").value === false ? "" : "checked"} ${ro("shm")}>
+              Mount /dev/shm (Memory-backed)</label>
+          </div>
+        </details>
         <div class="row">
           <button type="submit" class="primary">Launch</button>
           <a class="btn" href="#/notebooks">Cancel</a>
         </div>
       </form>
     </div>`;
+
+  // data-volume rows: existing-PVC picker or new-PVC spec
+  const volRows = [];
+  $("#f-addvol").onclick = () => {
+    const idx = volRows.length;
+    const row = document.createElement("div");
+    row.className = "row volrow";
+    row.innerHTML = `
+      <select class="v-src">
+        <option value="">new volume…</option>
+        ${existingPvcs.map((p) => `<option>${esc(p)}</option>`).join("")}
+      </select>
+      <input class="v-name" placeholder="name" value="{notebook-name}-vol-${idx}">
+      <input class="v-size" placeholder="size" value="5Gi" size="5">
+      <input class="v-mount" placeholder="mount" value="/home/jovyan/data-${idx}">
+      <button type="button" class="btn v-del">✕</button>`;
+    const sync = () => {
+      const isNew = !row.querySelector(".v-src").value;
+      row.querySelector(".v-name").hidden = !isNew;
+      row.querySelector(".v-size").hidden = !isNew;
+    };
+    row.querySelector(".v-src").onchange = sync;
+    row.querySelector(".v-del").onclick = () => {
+      volRows.splice(volRows.indexOf(row), 1);
+      row.remove();
+    };
+    $("#f-datavols").appendChild(row);
+    volRows.push(row);
+    sync();
+  };
 
   // server type drives which image list the dropdown offers
   $("#f-servertype").onchange = () => {
@@ -353,6 +436,26 @@ route(/^\/notebooks\/new$/, async () => {
     ev.preventDefault();
     const name = $("#f-name").value.trim();
     const serverType = $("#f-servertype").value;
+    const environment = {};
+    for (const line of $("#f-env").value.split("\n")) {
+      const m = line.match(/^\s*([^=\s]+)\s*=\s*(.*)$/);
+      if (m) environment[m[1]] = m[2];
+    }
+    const datavols = volRows.map((row) => {
+      const src = row.querySelector(".v-src").value;
+      const mount = row.querySelector(".v-mount").value;
+      if (src) {
+        return { mount, existingSource: {
+          persistentVolumeClaim: { claimName: src } } };
+      }
+      return { mount, newPvc: {
+        metadata: { name: row.querySelector(".v-name").value },
+        spec: {
+          resources: { requests: {
+            storage: row.querySelector(".v-size").value } },
+          accessModes: ["ReadWriteOnce"],
+        } } };
+    });
     const body = {
       name,
       [imageFieldFor(serverType)]: $("#f-image").value,
@@ -361,12 +464,13 @@ route(/^\/notebooks\/new$/, async () => {
       cpu: $("#f-cpu").value,
       memory: $("#f-memory").value,
       tpu: accel === "none" ? null : { acceleratorType: accel },
-      tolerationGroup: "none",
-      affinityConfig: "none",
-      configurations: [],
-      shm: true,
-      environment: {},
-      datavols: [],
+      tolerationGroup: $("#f-tolerations").value,
+      affinityConfig: $("#f-affinity").value,
+      configurations: [...document.querySelectorAll(".f-poddefault:checked")]
+        .map((el) => el.value),
+      shm: $("#f-shm").checked,
+      environment,
+      datavols,
     };
     if ($("#f-workspace").checked) {
       body.workspace = {

@@ -87,6 +87,10 @@ def drive(url: str, headed: bool = False) -> None:
         page.goto(f"{url}/#/notebooks/new")
         page.fill("#f-name", nb)
         page.click(f'.slice-chip[data-accel="{ACCEL}"]')
+        # advanced section: env var + an attached new data volume
+        page.click("details.field summary")
+        page.fill("#f-env", "E2E_FLAG=1")
+        page.click("#f-addvol")
         page.click('#spawn button[type="submit"]')
 
         # table: the row walks the status ladder to ready

@@ -142,6 +142,74 @@ def test_gateway_spawn_through_browser_contract(stack):
     assert any("TPU_WORKER_ID=0" in line for line in logs)
 
 
+def test_gateway_spawn_with_advanced_options(stack):
+    """The advanced form section's body shape: PodDefault
+    configurations, data volumes (existing + new PVC), toleration
+    group, env vars — each must land on the rendered pods."""
+    from kubeflow_rm_tpu.controlplane.api.meta import (
+        deep_get, make_object,
+    )
+
+    api, mgr = stack
+    # a PodDefault + an existing PVC to attach
+    pd = make_object("kubeflow.org/v1alpha1", "PodDefault", "gcs-creds",
+                     "team")
+    pd["spec"] = {
+        "desc": "GCS credentials",
+        "selector": {"matchLabels": {"use-gcs-creds": "true"}},
+        "env": [{"name": "GOOGLE_CLOUD_PROJECT", "value": "proj"}],
+    }
+    api.create(pd)
+    pvc = make_object("v1", "PersistentVolumeClaim", "datasets", "team")
+    pvc["spec"] = {"resources": {"requests": {"storage": "10Gi"}},
+                   "accessModes": ["ReadWriteOnce"]}
+    api.create(pvc)
+
+    c = gateway_client(api)
+    pds = json.loads(c.get(
+        "/jupyter/api/namespaces/team/poddefaults").get_data())["poddefaults"]
+    label_key = list(pds[0]["label"])[0]
+    body = {
+        "name": "adv", "image": "ghcr.io/kubeflow-rm-tpu/jupyter-jax:latest",
+        "imagePullPolicy": "IfNotPresent", "serverType": "jupyter",
+        "cpu": "4", "memory": "16Gi",
+        "tpu": {"acceleratorType": "v5p-16"},
+        "tolerationGroup": "tpu-preemptible", "affinityConfig": "none",
+        "configurations": [label_key], "shm": True,
+        "environment": {"HF_HOME": "/home/jovyan/.cache"},
+        "datavols": [
+            {"mount": "/data", "existingSource": {
+                "persistentVolumeClaim": {"claimName": "datasets"}}},
+            {"mount": "/scratch", "newPvc": {
+                "metadata": {"name": "{notebook-name}-scratch"},
+                "spec": {"resources": {"requests": {"storage": "5Gi"}},
+                         "accessModes": ["ReadWriteOnce"]}}},
+        ],
+    }
+    resp = c.post("/jupyter/api/namespaces/team/notebooks",
+                  data=json.dumps(body),
+                  headers=[("Content-Type", "application/json")])
+    assert resp.status_code == 200, resp.get_data()
+    mgr.run_until_idle()
+
+    pods = [p for p in api.list("Pod", "team")
+            if p["metadata"]["name"].startswith("adv-")]
+    assert len(pods) == 2
+    for pod in pods:
+        env = {e["name"]: e.get("value")
+               for cont in pod["spec"]["containers"]
+               for e in cont.get("env", [])}
+        assert env["HF_HOME"] == "/home/jovyan/.cache"
+        assert env["GOOGLE_CLOUD_PROJECT"] == "proj"  # PodDefault merged
+        mounts = {m["mountPath"] for cont in pod["spec"]["containers"]
+                  for m in cont.get("volumeMounts", [])}
+        assert {"/data", "/scratch"} <= mounts
+        tol = deep_get(pod, "spec", "tolerations", default=[]) or []
+        assert any(t.get("key") == "cloud.google.com/gke-preemptible"
+                   for t in tol)
+    assert api.try_get("PersistentVolumeClaim", "adv-scratch", "team")
+
+
 def test_gateway_csrf_enforced(stack):
     api, _ = stack
     from werkzeug.test import Client

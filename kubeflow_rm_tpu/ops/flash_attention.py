@@ -51,10 +51,12 @@ NEG_INF = -2.0**30
 # multiples of 128 but not 1024 (1280, 1536, ...) stay on the kernel.
 import os
 
-# KFRM_FLASH_BLOCK overrides both defaults — the bench sweep's knob
-# (testing/mfu_sweep notes); code callers pass block_q/block_k.
-DEFAULT_BLOCK_Q = int(os.environ.get("KFRM_FLASH_BLOCK", 1024))
-DEFAULT_BLOCK_K = int(os.environ.get("KFRM_FLASH_BLOCK", 1024))
+# KFRM_FLASH_BLOCK overrides both defaults (KFRM_FLASH_BLOCK_Q/_K win
+# for asymmetric grids) — the bench sweep's knob; code callers pass
+# block_q/block_k explicitly.
+_BLOCK_ENV = os.environ.get("KFRM_FLASH_BLOCK", 1024)
+DEFAULT_BLOCK_Q = int(os.environ.get("KFRM_FLASH_BLOCK_Q", _BLOCK_ENV))
+DEFAULT_BLOCK_K = int(os.environ.get("KFRM_FLASH_BLOCK_K", _BLOCK_ENV))
 
 
 def pick_block(preferred: int, T: int) -> int:

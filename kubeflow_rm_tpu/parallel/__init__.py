@@ -9,6 +9,10 @@ from kubeflow_rm_tpu.parallel.ring_attention import (
     ring_attention,
     ring_self_attention,
 )
+from kubeflow_rm_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_self_attention,
+)
 from kubeflow_rm_tpu.parallel.zigzag_ring import (
     zigzag_permutation,
     zigzag_positions,
@@ -26,6 +30,8 @@ __all__ = [
     "param_shardings",
     "ring_attention",
     "ring_self_attention",
+    "ulysses_attention",
+    "ulysses_self_attention",
     "zigzag_permutation",
     "zigzag_positions",
     "zigzag_ring_attention",

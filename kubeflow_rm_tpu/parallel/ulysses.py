@@ -1,0 +1,134 @@
+"""Ulysses-style sequence parallelism: all-to-all over the ``sp`` axis.
+
+The second of the two context-parallel schedules this framework ships
+(the other being ``ring_attention``/``zigzag_ring``). Where the ring
+rotates K/V chunks and keeps heads whole, Ulysses re-shards with two
+all-to-alls: heads scatter across ``sp`` while the sequence gathers, so
+each device runs *ordinary full-sequence attention* on H/sp heads, then
+the inverse all-to-all restores the sequence layout. (Pattern from the
+public DeepSpeed-Ulysses literature; implementation is jax-native over
+``shard_map`` + ``lax.all_to_all``.)
+
+Trade-off vs the ring, in ICI terms:
+
+- **Ulysses**: 2 all-to-alls moving O(T·D·H/sp) per device, then the
+  whole attention is ONE dense local call — the pallas flash kernel
+  runs unmodified on (B, T, H/sp, D), so per-block softmax tricks,
+  segment masks and the tuned 1024-block grid all apply.
+- **Ring**: sp point-to-point hops overlapped with compute, memory
+  stays O(T/sp) per device. Wins when T is too long for any device to
+  hold the full sequence; Ulysses wins when heads are plentiful and
+  the fused kernel beats sp smaller block matmuls.
+
+Composes with the rest of the mesh exactly like the ring: only ``sp``
+is manual; batch/head remainders stay under GSPMD.
+
+The reference platform has no long-context story at all (SURVEY.md §5);
+like the ring schedule this is TPU-native capability, not a port.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_rm_tpu.ops.attention import dot_product_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    positions_q: jax.Array | None = None,
+    positions_kv: jax.Array | None = None,
+    segment_ids_q: jax.Array | None = None,
+    segment_ids_kv: jax.Array | None = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Sequence-parallel attention via head/sequence all-to-all.
+
+    Call inside ``shard_map`` with ``axis_name`` manual. Shapes are the
+    local chunks: q (B, Tloc, H, D), k/v (B, Tloc, KVH, D), optional
+    positions/segments (B, Tloc). Requires ``H % sp == 0``; KV heads
+    that don't divide ``sp`` are broadcast up to H first (GQA loses its
+    K/V memory saving across the scatter, never correctness).
+
+    Returns the local (B, Tloc, H, D) output chunk.
+    """
+    n = jax.lax.axis_size(axis_name)
+    B, Tloc, H, D = q.shape
+    KVH = k.shape[2]
+    if H % n:
+        raise ValueError(
+            f"ulysses needs sp ({n}) to divide n_heads ({H})")
+    if KVH % n:
+        reps = H // KVH
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    # scatter heads, gather sequence: (B, Tloc, H, D) -> (B, T, H/sp, D)
+    qg = a2a(q, split_axis=2, concat_axis=1)
+    kg = a2a(k, split_axis=2, concat_axis=1)
+    vg = a2a(v, split_axis=2, concat_axis=1)
+
+    def gather_seq(x):
+        return None if x is None else jax.lax.all_gather(
+            x, axis_name, axis=1, tiled=True)
+
+    out = dot_product_attention(
+        qg, kg, vg, causal=causal,
+        positions_q=gather_seq(positions_q),
+        positions_kv=gather_seq(positions_kv),
+        segment_ids_q=gather_seq(segment_ids_q),
+        segment_ids_kv=gather_seq(segment_ids_kv),
+        impl=impl,
+    )
+    # inverse: scatter sequence, gather heads
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def ulysses_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                           positions: jax.Array | None = None,
+                           segments: jax.Array | None = None,
+                           impl: str = "auto"):
+    """Global-view convenience wrapper, mirror of ``ring_self_attention``:
+    inputs are global (B, T, H, D) arrays on ``mesh``; only ``sp`` goes
+    manual, batch/head axes stay under GSPMD."""
+    spec = P(None, "sp", None, None)
+    sspec = P(None, "sp")
+
+    if positions is None and segments is None:
+        fn = jax.shard_map(
+            partial(ulysses_attention, axis_name="sp", causal=causal,
+                    impl=impl),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            axis_names={"sp"},
+        )
+        return fn(q, k, v)
+
+    B, T = q.shape[:2]
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    if segments is None:
+        segments = jnp.zeros((B, T), jnp.int32)
+
+    def local(q, k, v, pos, seg):
+        return ulysses_attention(
+            q, k, v, axis_name="sp", causal=causal, impl=impl,
+            positions_q=pos, positions_kv=pos,
+            segment_ids_q=seg, segment_ids_kv=seg)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec, sspec, sspec),
+        out_specs=spec,
+        axis_names={"sp"},
+    )
+    return fn(q, k, v, positions, segments)

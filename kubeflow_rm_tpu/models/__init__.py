@@ -6,6 +6,12 @@ import jax
 
 from kubeflow_rm_tpu.models import llama as _llama
 from kubeflow_rm_tpu.models import mixtral as _mixtral
+from kubeflow_rm_tpu.models.generate import (
+    KVCache,
+    decode_chunk,
+    generate,
+    init_cache,
+)
 from kubeflow_rm_tpu.models.llama import LlamaConfig, forward
 from kubeflow_rm_tpu.models.mixtral import MixtralConfig
 
@@ -25,5 +31,6 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
     return _llama.forward(params, tokens, cfg, **kwargs), None
 
 
-__all__ = ["LlamaConfig", "MixtralConfig", "init_params", "forward",
-           "forward_with_aux"]
+__all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "decode_chunk",
+           "forward", "forward_with_aux", "generate", "init_cache",
+           "init_params"]

@@ -1,0 +1,164 @@
+"""KV-cached autoregressive generation for the Llama family.
+
+The in-notebook inference path: prefill the prompt in one pass, then
+decode a token per step against a preallocated static-shape cache —
+every step is the SAME jitted computation (no data-dependent shapes),
+which is what XLA wants on TPU. Exactness against the training
+``forward`` is asserted by ``tests/test_generate.py``.
+
+TPU-first choices:
+
+- **Static cache** (B, max_len, KVH, hd) per layer, stacked on a
+  leading layer axis like the weights, updated with
+  ``lax.dynamic_update_slice`` — one compiled step serves the whole
+  generation, prefill included (prefill is just a wider chunk).
+- **Position-masked attention**: unfilled cache slots carry position
+  ``INT32_MAX``, so the standard ``pos_q >= pos_kv`` causal mask of
+  ``ops.dot_product_attention`` excludes them — no second mask path to
+  keep in sync with training.
+- **Layer scan**: the cache rides ``lax.scan`` as scanned xs/ys over
+  the same stacked-parameter layout training uses, so compile time
+  stays depth-independent.
+
+The reference platform ships no model runtime at all; this module is
+capability the jupyter-jax image adds on top (SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_rm_tpu.models.llama import LlamaConfig, _epilogue
+from kubeflow_rm_tpu.ops import (
+    apply_rope,
+    dot_product_attention,
+    rms_norm,
+    rope_angles,
+)
+
+_UNFILLED = jnp.iinfo(jnp.int32).max
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KVCache:
+    k: jax.Array          # (L, B, S, KVH, hd) compute dtype
+    v: jax.Array          # (L, B, S, KVH, hd)
+    positions: jax.Array  # (B, S) int32; _UNFILLED marks empty slots
+    offset: jax.Array     # () int32: next write index
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
+    L, KVH, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    return KVCache(
+        k=jnp.zeros((L, batch, max_len, KVH, hd), cfg.dtype),
+        v=jnp.zeros((L, batch, max_len, KVH, hd), cfg.dtype),
+        positions=jnp.full((batch, max_len), _UNFILLED, jnp.int32),
+        offset=jnp.zeros((), jnp.int32),
+    )
+
+
+def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
+                 tokens: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Run ``tokens`` (B, Tc) through the model at the cache offset.
+
+    One function serves prefill (Tc = prompt length) and decode
+    (Tc = 1). Returns (logits (B, Tc, V) fp32, updated cache). The
+    chunk must fit: offset + Tc <= cache length.
+    """
+    B, Tc = tokens.shape
+    H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.dtype
+
+    positions = cache.offset + jnp.arange(Tc, dtype=jnp.int32)
+    positions = jnp.broadcast_to(positions, (B, Tc))
+    cos, sin = rope_angles(positions, hd, cfg.rope_theta)
+    kv_positions = jax.lax.dynamic_update_slice(
+        cache.positions, positions, (0, cache.offset))
+
+    x = params["embed"]["tokens"][tokens].astype(cdt)
+
+    def body(x, scanned):
+        layer, ck, cv = scanned
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"].astype(cdt)).reshape(B, Tc, H, hd)
+        k = (h @ layer["wk"].astype(cdt)).reshape(B, Tc, KVH, hd)
+        v = (h @ layer["wv"].astype(cdt)).reshape(B, Tc, KVH, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache.offset, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache.offset, 0, 0))
+        attn = dot_product_attention(
+            q, ck, cv, causal=True,
+            positions_q=positions, positions_kv=kv_positions,
+        )
+        x = x + attn.reshape(B, Tc, H * hd) @ layer["wo"].astype(cdt)
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        gate = h @ layer["w_gate"].astype(cdt)
+        up = h @ layer["w_up"].astype(cdt)
+        x = x + (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cdt)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v))
+    logits = _epilogue(params, x, cfg)
+    new_cache = KVCache(k=new_k, v=new_v, positions=kv_positions,
+                       offset=cache.offset + Tc)
+    return logits, new_cache
+
+
+def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
+             max_new_tokens: int, key: jax.Array | None = None,
+             temperature: float = 0.0, top_k: int | None = None,
+             eos_id: int | None = None,
+             max_len: int | None = None) -> jax.Array:
+    """Sample ``max_new_tokens`` continuations of ``prompt`` (B, Tp).
+
+    ``temperature`` 0 (default) is greedy argmax; otherwise softmax
+    sampling, optionally truncated to the ``top_k`` highest logits.
+    Sequences that emit ``eos_id`` keep it and then repeat it (static
+    shapes — the result is (B, Tp + max_new_tokens), pad-right).
+    """
+    B, Tp = prompt.shape
+    S = max_len or (Tp + max_new_tokens)
+    if S < Tp + max_new_tokens:
+        raise ValueError(
+            f"max_len={S} < prompt {Tp} + new {max_new_tokens}")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+
+    step = jax.jit(lambda c, t: decode_chunk(params, cfg, c, t),
+                   donate_argnums=(0,))
+
+    cache = init_cache(cfg, B, S)
+    logits, cache = step(cache, prompt)
+    last = logits[:, -1, :]
+
+    def pick(last, k):
+        if temperature <= 0:
+            return jnp.argmax(last, axis=-1).astype(jnp.int32)
+        scaled = last / temperature
+        if top_k:
+            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        return jax.random.categorical(k, scaled).astype(jnp.int32)
+
+    out = [prompt]
+    done = jnp.zeros((B,), bool)
+    for i in range(max_new_tokens):
+        if key is not None:
+            key, sub = jax.random.split(key)
+        else:
+            sub = None
+        nxt = pick(last, sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        out.append(nxt[:, None])
+        if i + 1 < max_new_tokens:
+            logits, cache = step(cache, nxt[:, None])
+            last = logits[:, -1, :]
+    return jnp.concatenate(out, axis=1)

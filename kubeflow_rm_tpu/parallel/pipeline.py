@@ -45,23 +45,47 @@ def pipeline_forward(
     tokens: jax.Array,
     cfg: LlamaConfig,
     mesh: Mesh,
+    **kw,
+) -> jax.Array:
+    """Causal LM forward pipelined over ``pp``; logits only (dense
+    families). See ``pipeline_forward_with_aux`` for the full contract."""
+    return pipeline_forward_with_aux(params, tokens, cfg, mesh, **kw)[0]
+
+
+def pipeline_forward_with_aux(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh: Mesh,
     *,
     n_microbatches: int | None = None,
     positions: jax.Array | None = None,
     segments: jax.Array | None = None,
     packed: bool = False,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array | None]:
     """Causal LM forward with the layer stack pipelined over ``pp``.
 
-    Semantically identical to ``models.llama.forward`` (same math, same
-    remat policy per stage); exactness is asserted by
-    ``tests/test_pipeline.py``. Requires ``cfg.n_layers % pp == 0`` and
-    ``B % n_microbatches == 0``.
+    Returns ``(logits, router_aux)`` — aux is the mean-per-(layer,
+    microbatch) MoE load-balancing loss for Mixtral-family configs and
+    ``None`` for dense ones. Semantically identical to the family's
+    plain ``forward`` (same math, same remat policy per stage);
+    exactness is asserted by ``tests/test_pipeline.py``. (For MoE the
+    aux term is exactly equal only at ``n_microbatches=1`` — the
+    load-balance statistic is nonlinear in the batch, so microbatching
+    changes it slightly, same as gradient accumulation does.) Requires
+    ``cfg.n_layers % pp == 0`` and ``B % n_microbatches == 0``.
     """
+    from kubeflow_rm_tpu.models.mixtral import MixtralConfig, _moe_block
+
+    is_moe = isinstance(cfg, MixtralConfig)
     pp = mesh.shape.get("pp", 1)
     if pp == 1:
+        if is_moe:
+            from kubeflow_rm_tpu.models.mixtral import forward as moe_fwd
+            return moe_fwd(params, tokens, cfg, positions=positions,
+                           segments=segments, packed=packed)
         return forward(params, tokens, cfg, positions=positions,
-                       segments=segments, packed=packed)
+                       segments=segments, packed=packed), None
     if cfg.n_layers % pp:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={pp}")
@@ -77,6 +101,22 @@ def pipeline_forward(
     # block), then fold B -> (M, mb)
     x, cos, sin, attn_positions, block = _prologue(
         params, tokens, cfg, positions, segments, packed)
+
+    # normalize the per-layer block to the (h, aux) contract so one
+    # schedule serves both families
+    if is_moe:
+        from functools import partial
+
+        from kubeflow_rm_tpu.models.llama import _remat_policy
+
+        moe_block = partial(_moe_block, cfg)
+        if cfg.remat:
+            moe_block = jax.checkpoint(
+                moe_block, policy=_remat_policy(cfg.remat_policy))
+        block_aux = moe_block
+    else:
+        def block_aux(h, layer, *a):
+            return block(h, layer, *a), jnp.zeros((), jnp.float32)
 
     # Interleaved fold: microbatch m takes rows m, M+m, 2M+m, ... so
     # each device's contiguous block of batch rows lands one row in
@@ -122,26 +162,36 @@ def pipeline_forward(
             return jax.lax.with_sharding_constraint(a, act_spec)
 
         def stage_apply(h, cos_t, sin_t, pos_t, seg_t):
-            def body(h, layer):
-                return block(h, layer, cos_t, sin_t, pos_t, seg_t), None
+            def body(carry, layer):
+                h, aux = carry
+                h, a = block_aux(h, layer, cos_t, sin_t, pos_t, seg_t)
+                return (h, aux + a), None
 
-            h, _ = jax.lax.scan(body, h, blocks)
-            return h
+            aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32),
+                                 ("pp",), to="varying")
+            (h, aux), _ = jax.lax.scan(body, (h, aux0), blocks)
+            return h, aux
 
         def pick(a_mb, idx):
             return None if a_mb is None else jax.lax.dynamic_index_in_dim(
                 a_mb, idx, 0, keepdims=False)
 
         def tick(carry, t):
-            recv, outputs = carry
+            recv, outputs, aux_total = carry
             # stage s holds microbatch t - s; clamp keeps bubble ticks
             # on a valid (discarded) index instead of branching
             idx = jnp.clip(t - stage, 0, M - 1)
             inp = pin(jnp.where(stage == 0, pick(x_mb, idx), recv))
-            out = pin(stage_apply(inp, pick(cos_mb, idx), pick(sin_mb, idx),
-                                  pick(pos_mb, idx), pick(seg_mb, idx)))
+            out, aux = stage_apply(inp, pick(cos_mb, idx),
+                                   pick(sin_mb, idx),
+                                   pick(pos_mb, idx), pick(seg_mb, idx))
+            out = pin(out)
             recv_next = jax.lax.ppermute(
                 out, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            # bubble ticks compute on a clamped (garbage) microbatch:
+            # their aux must not pollute the router loss
+            valid = jnp.logical_and(t >= stage, t - stage <= M - 1)
+            aux_total = aux_total + jnp.where(valid, aux, 0.0)
             # the last stage finishes microbatch t-(pp-1) at tick t
             w = jnp.clip(t - (pp - 1), 0, M - 1)
             keep = jnp.logical_and(stage == pp - 1, t >= pp - 1)
@@ -151,28 +201,39 @@ def pipeline_forward(
                 jax.lax.dynamic_update_index_in_dim(
                     outputs, jnp.where(keep, out, cur), w, 0),
                 outs_spec)
-            return (recv_next, outputs), None
+            return (recv_next, outputs, aux_total), None
 
         # the carry is stage-varying from tick 1 on; mark the initial
         # zeros varying over pp so scan's type check agrees
         carry0 = jax.lax.pcast(
-            (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb)),
+            (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb),
+             jnp.zeros((), jnp.float32)),
             ("pp",), to="varying")
-        (_, outputs), _ = jax.lax.scan(
+        (_, outputs, aux_total), _ = jax.lax.scan(
             tick, carry0, jnp.arange(M + pp - 1))
-        # broadcast the last stage's results to every pp shard
-        return jax.lax.psum(
-            jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)),
-            "pp")
+        # broadcast the last stage's results to every pp shard; sum the
+        # per-stage aux contributions (each (layer, microbatch) pair is
+        # counted exactly once across stages)
+        return (
+            jax.lax.psum(
+                jnp.where(stage == pp - 1, outputs,
+                          jnp.zeros_like(outputs)), "pp"),
+            jax.lax.psum(aux_total, "pp"),
+        )
 
     in_specs = (stack_spec, mb_spec, mb_spec, mb_spec,
                 None if pos_mb is None else mb_spec,
                 None if seg_mb is None else mb_spec)
-    h_mb = jax.shard_map(
-        spmd, mesh=mesh, in_specs=in_specs, out_specs=mb_spec,
+    h_mb, aux_total = jax.shard_map(
+        spmd, mesh=mesh, in_specs=in_specs, out_specs=(mb_spec, P()),
         axis_names={"pp"},
     )(params["blocks"], x_mb, cos_mb, sin_mb, pos_mb, seg_mb)
 
     # inverse of the interleaved fold
-    return _epilogue(
+    logits = _epilogue(
         params, h_mb.swapaxes(0, 1).reshape(B, T, cfg.dim), cfg)
+    if not is_moe:
+        return logits, None
+    # mean per (layer, microbatch), matching the dense forward's
+    # mean-per-layer normalization
+    return logits, aux_total / (cfg.n_layers * M)

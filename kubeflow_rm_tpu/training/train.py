@@ -21,7 +21,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_rm_tpu.models import (
     LlamaConfig,
-    MixtralConfig,
     forward_with_aux,
     init_params,
 )
@@ -89,17 +88,12 @@ def loss_fn(params, batch, cfg: TrainConfig,
                   segments=batch.get("segments"),
                   packed=batch.get("segments") is not None)
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
-        if isinstance(cfg.model, MixtralConfig):
-            # a plain forward on a pp>1 mesh would all-gather the
-            # pp-sharded layer stack every step — refuse rather than
-            # silently degrade
-            raise NotImplementedError(
-                "MoE models have no pipeline schedule yet; use a pp=1 "
-                "mesh for MixtralConfig")
-        from kubeflow_rm_tpu.parallel.pipeline import pipeline_forward
-        logits = pipeline_forward(params, batch["tokens"], cfg.model, mesh,
-                                  n_microbatches=n_microbatches, **kwargs)
-        router_aux = None
+        from kubeflow_rm_tpu.parallel.pipeline import (
+            pipeline_forward_with_aux,
+        )
+        logits, router_aux = pipeline_forward_with_aux(
+            params, batch["tokens"], cfg.model, mesh,
+            n_microbatches=n_microbatches, **kwargs)
     else:
         logits, router_aux = forward_with_aux(params, batch["tokens"],
                                               cfg.model, **kwargs)

@@ -6,6 +6,7 @@ import jax
 
 from kubeflow_rm_tpu.models import llama as _llama
 from kubeflow_rm_tpu.models import mixtral as _mixtral
+from kubeflow_rm_tpu.models.convert import config_from_hf, from_hf_llama
 from kubeflow_rm_tpu.models.generate import (
     KVCache,
     decode_chunk,
@@ -31,6 +32,6 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
     return _llama.forward(params, tokens, cfg, **kwargs), None
 
 
-__all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "decode_chunk",
-           "forward", "forward_with_aux", "generate", "init_cache",
-           "init_params"]
+__all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "config_from_hf",
+           "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",
+           "generate", "init_cache", "init_params"]

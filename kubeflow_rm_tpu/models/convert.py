@@ -1,0 +1,118 @@
+"""Hugging Face Llama checkpoint → this framework's parameter layout.
+
+The bridge a user switching from the reference world needs: take any
+``transformers.LlamaForCausalLM`` (or its state_dict) and produce the
+layer-stacked pytree ``models.llama.forward`` consumes, plus the
+matching ``LlamaConfig``. Conventions line up by construction —
+``ops.rope.apply_rope`` uses the same split-halves rotation as HF's
+``rotate_half``, so projections transfer as plain transposes (the
+torch Linear stores (out, in); we store (in, out)) with NO head
+permutation. Exactness against the HF forward is asserted by
+``tests/test_convert.py``, which is also the strongest fidelity proof
+of the model math itself.
+
+Layout mapping (HF name → pytree path, per layer i stacked on axis 0):
+
+    model.embed_tokens.weight              embed/tokens      (V, D)
+    model.layers.i.input_layernorm.weight  blocks/attn_norm  (L, D)
+    model.layers.i.self_attn.q_proj.weight blocks/wq         (L, D, H*hd)   [T]
+    ...k_proj / v_proj                     blocks/wk, wv     (L, D, KVH*hd) [T]
+    ...o_proj                              blocks/wo         (L, H*hd, D)   [T]
+    model.layers.i.post_attention_layernorm.weight blocks/mlp_norm (L, D)
+    model.layers.i.mlp.gate_proj.weight    blocks/w_gate     (L, D, F)      [T]
+    ...up_proj / down_proj                 blocks/w_up, w_down               [T]
+    model.norm.weight                      out_norm          (D,)
+    lm_head.weight                         lm_head           (D, V)         [T]
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_rm_tpu.models.llama import LlamaConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / np array → float32 numpy (host)."""
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu").float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def config_from_hf(hf_config: Any, **overrides) -> LlamaConfig:
+    """Derive a ``LlamaConfig`` from a transformers LlamaConfig."""
+    base = LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        hidden_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(hf_config.rms_norm_eps),
+    )
+    return replace(base, **overrides)
+
+
+def from_hf_llama(model_or_state: Any,
+                  cfg: LlamaConfig | None = None,
+                  param_dtype=jnp.float32) -> tuple[LlamaConfig, dict]:
+    """Convert an HF ``LlamaForCausalLM`` (or its state_dict).
+
+    Returns ``(cfg, params)`` ready for ``forward``/``generate``. A
+    model instance also yields the config; from a bare state_dict pass
+    ``cfg`` explicitly. Tied-embedding checkpoints (no ``lm_head``
+    entry) reuse the embedding matrix, matching HF's tie behavior.
+    """
+    if hasattr(model_or_state, "state_dict"):
+        state = model_or_state.state_dict()
+        if cfg is None:
+            cfg = config_from_hf(model_or_state.config)
+    else:
+        state = dict(model_or_state)
+        if cfg is None:
+            raise ValueError("pass cfg when converting a bare state_dict")
+
+    def get(name):
+        for key in (name, f"model.{name}"):
+            if key in state:
+                return _np(state[key])
+        raise KeyError(f"{name} not found in state_dict "
+                       f"(keys: {sorted(state)[:8]}...)")
+
+    L = cfg.n_layers
+
+    def stack(fmt, transpose=False):
+        mats = [get(fmt.format(i=i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(np.stack(mats), param_dtype)
+
+    embed = jnp.asarray(get("embed_tokens.weight"), param_dtype)
+    try:
+        lm_head = jnp.asarray(get("lm_head.weight").T, param_dtype)
+    except KeyError:
+        lm_head = embed.T  # tied embeddings
+    params = {
+        "embed": {"tokens": embed},
+        "blocks": {
+            "attn_norm": stack("layers.{i}.input_layernorm.weight"),
+            "wq": stack("layers.{i}.self_attn.q_proj.weight", True),
+            "wk": stack("layers.{i}.self_attn.k_proj.weight", True),
+            "wv": stack("layers.{i}.self_attn.v_proj.weight", True),
+            "wo": stack("layers.{i}.self_attn.o_proj.weight", True),
+            "mlp_norm": stack("layers.{i}.post_attention_layernorm.weight"),
+            "w_gate": stack("layers.{i}.mlp.gate_proj.weight", True),
+            "w_up": stack("layers.{i}.mlp.up_proj.weight", True),
+            "w_down": stack("layers.{i}.mlp.down_proj.weight", True),
+        },
+        "out_norm": jnp.asarray(get("norm.weight"), param_dtype),
+        "lm_head": lm_head,
+    }
+    return cfg, params

@@ -9,9 +9,11 @@ from kubeflow_rm_tpu.models import mixtral as _mixtral
 from kubeflow_rm_tpu.models.convert import config_from_hf, from_hf_llama
 from kubeflow_rm_tpu.models.generate import (
     KVCache,
+    cache_shardings,
     decode_chunk,
     generate,
     init_cache,
+    make_decode_step,
 )
 from kubeflow_rm_tpu.models.llama import LlamaConfig, forward
 from kubeflow_rm_tpu.models.mixtral import MixtralConfig
@@ -33,5 +35,5 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
 
 
 __all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "config_from_hf",
-           "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",
-           "generate", "init_cache", "init_params"]
+           "cache_shardings", "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",
+           "generate", "init_cache", "init_params", "make_decode_step"]

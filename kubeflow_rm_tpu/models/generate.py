@@ -110,6 +110,47 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
     return logits, new_cache
 
 
+def cache_shardings(cfg: LlamaConfig, mesh) -> KVCache:
+    """NamedSharding pytree for a KVCache on ``mesh``: batch over
+    (dp, fsdp), KV heads over tp — the decode-time analogue of
+    ``parallel.sharding`` (weights stay on their training shardings)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return KVCache(
+        k=NamedSharding(mesh, P(None, ("dp", "fsdp"), None, "tp", None)),
+        v=NamedSharding(mesh, P(None, ("dp", "fsdp"), None, "tp", None)),
+        positions=NamedSharding(mesh, P(("dp", "fsdp"), None)),
+        offset=NamedSharding(mesh, P()),
+    )
+
+
+def make_decode_step(example_params: dict, cfg: LlamaConfig, mesh):
+    """Jitted sharded ``(params, cache, tokens) -> (logits, cache)``.
+
+    Params carry their training shardings (``parallel.sharding`` rules
+    — serve on an fsdp×tp mesh), the cache follows ``cache_shardings``
+    and is donated so decode runs in-place in HBM; logits come back
+    vocab-sharded over tp. ``example_params`` is only inspected for the
+    pytree structure. Exactness vs the unsharded path is asserted by
+    ``tests/test_generate.py``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_rm_tpu.parallel.sharding import (
+        batch_pspec, param_shardings,
+    )
+
+    return jax.jit(
+        lambda p, cache, tokens: decode_chunk(p, cfg, cache, tokens),
+        in_shardings=(param_shardings(example_params, mesh),
+                      cache_shardings(cfg, mesh),
+                      NamedSharding(mesh, batch_pspec(False))),
+        out_shardings=(NamedSharding(mesh, P(("dp", "fsdp"), None, "tp")),
+                       cache_shardings(cfg, mesh)),
+        donate_argnums=(1,),
+    )
+
+
 def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
              max_new_tokens: int, key: jax.Array | None = None,
              temperature: float = 0.0, top_k: int | None = None,

@@ -171,11 +171,14 @@ def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
 
-    step = jax.jit(lambda c, t: decode_chunk(params, cfg, c, t),
-                   donate_argnums=(0,))
+    # params as a jit ARGUMENT, never a closure: captured weights would
+    # be baked into the lowered module as constants (a multi-GB HLO for
+    # real models, observed to wedge remote-compile paths)
+    step = jax.jit(lambda p, c, t: decode_chunk(p, cfg, c, t),
+                   donate_argnums=(1,))
 
     cache = init_cache(cfg, B, S)
-    logits, cache = step(cache, prompt)
+    logits, cache = step(params, cache, prompt)
     last = logits[:, -1, :]
 
     def pick(last, k):
@@ -200,6 +203,6 @@ def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
             done = done | (nxt == eos_id)
         out.append(nxt[:, None])
         if i + 1 < max_new_tokens:
-            logits, cache = step(cache, nxt[:, None])
+            logits, cache = step(params, cache, nxt[:, None])
             last = logits[:, -1, :]
     return jnp.concatenate(out, axis=1)

@@ -116,3 +116,34 @@ def from_hf_llama(model_or_state: Any,
         "lm_head": lm_head,
     }
     return cfg, params
+
+
+def to_hf_llama(cfg: LlamaConfig, params: dict) -> dict:
+    """Export the stacked pytree as an HF-keyed numpy state_dict.
+
+    The inverse of ``from_hf_llama`` — load it into a
+    ``transformers.LlamaForCausalLM`` via ``load_state_dict`` (after
+    wrapping values in torch tensors) to hand a fine-tuned checkpoint
+    back to the HF ecosystem. Roundtrip fidelity is asserted by
+    ``tests/test_convert.py``.
+    """
+    blocks = params["blocks"]
+    state = {
+        "model.embed_tokens.weight": _np(params["embed"]["tokens"]),
+        "model.norm.weight": _np(params["out_norm"]),
+        "lm_head.weight": _np(params["lm_head"]).T,
+    }
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}"
+        state[f"{pre}.input_layernorm.weight"] = _np(blocks["attn_norm"][i])
+        state[f"{pre}.post_attention_layernorm.weight"] = \
+            _np(blocks["mlp_norm"][i])
+        for ours, theirs in (("wq", "self_attn.q_proj"),
+                             ("wk", "self_attn.k_proj"),
+                             ("wv", "self_attn.v_proj"),
+                             ("wo", "self_attn.o_proj"),
+                             ("w_gate", "mlp.gate_proj"),
+                             ("w_up", "mlp.up_proj"),
+                             ("w_down", "mlp.down_proj")):
+            state[f"{pre}.{theirs}.weight"] = _np(blocks[ours][i]).T
+    return state

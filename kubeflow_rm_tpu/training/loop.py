@@ -43,6 +43,7 @@ class LoopConfig:
     checkpoint_dir: str | None = None  # None = no checkpointing
     max_to_keep: int = 3
     seed: int = 0
+    grad_accum: int = 1                # microbatches per optimizer step
 
 
 @dataclass
@@ -113,7 +114,8 @@ def fit(
         return state, []
     if batch_keys is None:
         batch_keys = tuple(first.keys())
-    step_fn = make_train_step(cfg, mesh, state, batch_keys=batch_keys)
+    step_fn = make_train_step(cfg, mesh, state, batch_keys=batch_keys,
+                              grad_accum=loop.grad_accum)
 
     n_dev = mesh.devices.size
     peak = device_peak_flops(jax.tree_util.tree_leaves(mesh.devices)[0])

@@ -83,3 +83,22 @@ def test_tied_embeddings_fallback(hf_model):
 def test_bare_state_dict_requires_cfg(hf_model):
     with pytest.raises(ValueError, match="cfg"):
         from_hf_llama(hf_model.state_dict())
+
+
+def test_roundtrip_back_into_hf(hf_model):
+    """Export → load_state_dict into a fresh HF model → identical
+    logits: the full both-ways bridge."""
+    from kubeflow_rm_tpu.models.convert import to_hf_llama
+
+    cfg, params = from_hf_llama(hf_model)
+    state = {k: torch.tensor(v) for k, v in
+             to_hf_llama(cfg, params).items()}
+    fresh = transformers.LlamaForCausalLM(hf_model.config)
+    fresh.load_state_dict(state)
+    fresh.eval()
+    tokens = torch.tensor(
+        np.random.default_rng(2).integers(0, 128, (1, 11)))
+    with torch.no_grad():
+        a = hf_model(tokens).logits.numpy()
+        b = fresh(tokens).logits.numpy()
+    np.testing.assert_allclose(b, a, atol=1e-5)

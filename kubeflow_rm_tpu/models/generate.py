@@ -81,6 +81,22 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
 
     x = params["embed"]["tokens"][tokens].astype(cdt)
 
+    # family dispatch for the FFN half: dense SwiGLU or expert mixture
+    # (the router aux loss is a training quantity — discarded at decode)
+    from kubeflow_rm_tpu.models.mixtral import MixtralConfig
+
+    if isinstance(cfg, MixtralConfig):
+        from kubeflow_rm_tpu.parallel.moe import moe_ffn
+
+        def ffn(layer, h):
+            out, _aux = moe_ffn(layer, h, cfg.moe, dtype=cdt)
+            return out
+    else:
+        def ffn(layer, h):
+            gate = h @ layer["w_gate"].astype(cdt)
+            up = h @ layer["w_up"].astype(cdt)
+            return (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cdt)
+
     def body(x, scanned):
         layer, ck, cv = scanned
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
@@ -96,10 +112,7 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
             positions_q=positions, positions_kv=kv_positions,
         )
         x = x + attn.reshape(B, Tc, H * hd) @ layer["wo"].astype(cdt)
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = h @ layer["w_gate"].astype(cdt)
-        up = h @ layer["w_up"].astype(cdt)
-        x = x + (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cdt)
+        x = x + ffn(layer, rms_norm(x, layer["mlp_norm"], cfg.norm_eps))
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(

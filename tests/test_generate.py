@@ -134,6 +134,35 @@ def test_sharded_decode_matches_single_device(model, devices8):
                                np.asarray(full[:, -1]), atol=1e-4)
 
 
+def test_moe_decode_matches_forward():
+    """The cache path carries the Mixtral family: tokenwise decode must
+    reproduce the MoE forward logits (capacity high enough that routing
+    drops nothing — the regime where decode and forward agree)."""
+    from dataclasses import replace
+
+    from kubeflow_rm_tpu.models.mixtral import MixtralConfig
+    from kubeflow_rm_tpu.models.mixtral import forward as moe_forward
+    from kubeflow_rm_tpu.models import init_params as init_any
+
+    cfg = MixtralConfig.tiny_moe()
+    cfg = replace(cfg, moe=replace(cfg.moe, capacity_factor=8.0))
+    params = init_any(cfg, jax.random.key(0))
+    T = 10
+    tokens = jax.random.randint(jax.random.key(6), (2, T), 0,
+                                cfg.vocab_size)
+    ref, _aux = moe_forward(params, tokens, cfg)
+
+    cache = init_cache(cfg, 2, T)
+    outs = []
+    for t in range(T):
+        logits, cache = decode_chunk(params, cfg, cache,
+                                     tokens[:, t:t + 1])
+        outs.append(logits)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4)
+
+
 def test_sampling_requires_key(model):
     cfg, params = model
     with pytest.raises(ValueError, match="PRNG key"):

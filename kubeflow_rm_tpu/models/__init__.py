@@ -28,7 +28,9 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
 
 def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
     """Uniform forward: returns (logits, aux) where aux is the router
-    load-balancing loss for MoE families and None for dense ones."""
+    load-balancing loss for MoE families and None for dense ones.
+    ``mesh`` (kwarg) enables explicit sequence-parallel attention
+    schedules when ``cfg.attention_backend`` asks for one."""
     if isinstance(cfg, MixtralConfig):
         return _mixtral.forward(params, tokens, cfg, **kwargs)
     return _llama.forward(params, tokens, cfg, **kwargs), None

@@ -61,6 +61,12 @@ class LlamaConfig:
     # "attn"/"mlp"/"attn+mlp": save the named activations only (the
     # HBM-vs-recompute middle ground — see _NAME_POLICIES).
     remat_policy: str = "dots"
+    # "auto": dense attention, GSPMD inserts whatever collectives the
+    # sp sharding needs (all-gather of K/V). "ring"/"ulysses": run the
+    # explicit sequence-parallel schedule (parallel.ring_attention /
+    # parallel.ulysses) when forward() is given a mesh with sp > 1 —
+    # O(T/sp) attention memory per device instead of a gathered T.
+    attention_backend: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -181,7 +187,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
 
 
 def _attention_half(cfg: LlamaConfig, x, layer, cos, sin, positions,
-                    segments):
+                    segments, mesh=None):
     """Pre-norm attention + residual. x: (B, T, D) in compute dtype.
 
     Activations are tagged with ``checkpoint_name`` so remat policies
@@ -202,20 +208,44 @@ def _attention_half(cfg: LlamaConfig, x, layer, cos, sin, positions,
     q = checkpoint_name(apply_rope(q, cos, sin), "q_rope")
     k = checkpoint_name(apply_rope(k, cos, sin), "k_rope")
     v = checkpoint_name(v, "v_proj")
-    attn = dot_product_attention(
-        q, k, v, causal=True, positions_q=positions, positions_kv=positions,
-        segment_ids_q=segments, segment_ids_kv=segments,
-    )
+    backend = cfg.attention_backend
+    if backend not in ("auto", "ring", "ulysses"):
+        raise ValueError(
+            f"attention_backend must be auto/ring/ulysses, got {backend!r}")
+    if (backend != "auto" and mesh is not None
+            and mesh.shape.get("sp", 1) > 1):
+        if backend == "ring":
+            from kubeflow_rm_tpu.parallel.ring_attention import (
+                ring_self_attention,
+            )
+            attn = ring_self_attention(q, k, v, mesh, causal=True,
+                                       positions=positions,
+                                       segments=segments)
+        else:
+            from kubeflow_rm_tpu.parallel.ulysses import (
+                ulysses_self_attention,
+            )
+            attn = ulysses_self_attention(q, k, v, mesh, causal=True,
+                                          positions=positions,
+                                          segments=segments)
+    else:
+        attn = dot_product_attention(
+            q, k, v, causal=True, positions_q=positions,
+            positions_kv=positions,
+            segment_ids_q=segments, segment_ids_kv=segments,
+        )
     attn = checkpoint_name(attn, "attn_out")
     return x + attn.reshape(B, T, H * hd) @ layer["wo"].astype(cdt)
 
 
-def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
+def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments,
+           mesh=None):
     """One transformer block (attention + dense SwiGLU MLP)."""
     from jax.ad_checkpoint import checkpoint_name
 
     cdt = cfg.dtype
-    x = _attention_half(cfg, x, layer, cos, sin, positions, segments)
+    x = _attention_half(cfg, x, layer, cos, sin, positions, segments,
+                        mesh=mesh)
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     gate = checkpoint_name(h @ layer["w_gate"].astype(cdt), "mlp_gate")
     up = checkpoint_name(h @ layer["w_up"].astype(cdt), "mlp_up")
@@ -224,7 +254,7 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments):
 
 
 def _prologue(params, tokens, cfg: LlamaConfig, positions, segments,
-              packed: bool):
+              packed: bool, mesh=None):
     """Shared forward prologue: the positions/packed mask contract,
     embedding gather, rope tables, and the remat-wrapped block. Used by
     both the plain ``forward`` and ``parallel.pipeline`` so the two
@@ -242,7 +272,7 @@ def _prologue(params, tokens, cfg: LlamaConfig, positions, segments,
     x = params["embed"]["tokens"][tokens].astype(cfg.dtype)
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
 
-    block = partial(_block, cfg)
+    block = partial(_block, cfg, mesh=mesh)
     if cfg.remat:
         block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
     return x, cos, sin, attn_positions, block
@@ -263,6 +293,7 @@ def forward(
     segments: jax.Array | None = None,
     *,
     packed: bool = False,
+    mesh=None,
 ) -> jax.Array:
     """Causal LM forward pass.
 
@@ -289,7 +320,7 @@ def forward(
       (B, T, vocab) fp32 logits.
     """
     x, cos, sin, attn_positions, block = _prologue(
-        params, tokens, cfg, positions, segments, packed)
+        params, tokens, cfg, positions, segments, packed, mesh=mesh)
 
     def scan_body(x, layer):
         return block(x, layer, cos, sin, attn_positions, segments), None

@@ -84,10 +84,11 @@ def init_params(cfg: MixtralConfig, key: jax.Array) -> dict:
 
 
 def _moe_block(cfg: MixtralConfig, x, layer, cos, sin, positions,
-               segments):
+               segments, mesh=None):
     """Attention half shared with Llama; MoE FFN half. Returns
     (x, aux_loss)."""
-    x = _attention_half(cfg, x, layer, cos, sin, positions, segments)
+    x = _attention_half(cfg, x, layer, cos, sin, positions, segments,
+                        mesh=mesh)
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     out, aux = moe_ffn(layer, h, cfg.moe, dtype=cfg.dtype)
     return x + out, aux
@@ -101,6 +102,7 @@ def forward(
     segments: jax.Array | None = None,
     *,
     packed: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Causal LM forward. Returns ((B, T, vocab) fp32 logits,
     mean-per-layer router aux loss)."""
@@ -111,7 +113,7 @@ def forward(
 
     from functools import partial
 
-    block = partial(_moe_block, cfg)
+    block = partial(_moe_block, cfg, mesh=mesh)
     if cfg.remat:
         from kubeflow_rm_tpu.models.llama import _remat_policy
         block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))

@@ -96,7 +96,8 @@ def loss_fn(params, batch, cfg: TrainConfig,
             n_microbatches=n_microbatches, **kwargs)
     else:
         logits, router_aux = forward_with_aux(params, batch["tokens"],
-                                              cfg.model, **kwargs)
+                                              cfg.model, mesh=mesh,
+                                              **kwargs)
     loss, aux = softmax_cross_entropy(logits, batch["labels"],
                                       z_loss=cfg.z_loss)
     if router_aux is not None:

@@ -60,7 +60,7 @@ def main(argv=None) -> int:
     import jax
     import numpy as np
 
-    from kubeflow_rm_tpu.models import LlamaConfig, generate, init_params
+    from kubeflow_rm_tpu.models import LlamaConfig, generate
     from kubeflow_rm_tpu.parallel import MeshConfig, make_mesh
     from kubeflow_rm_tpu.parallel.distributed import initialize
     from kubeflow_rm_tpu.training import TrainConfig
@@ -69,7 +69,7 @@ def main(argv=None) -> int:
         synthetic_batches,
     )
     from kubeflow_rm_tpu.training.loop import LoopConfig, fit
-    from kubeflow_rm_tpu.training.train import TrainState, init_train_state
+    from kubeflow_rm_tpu.training.train import TrainState
 
     # 1. the slice: no-op on single-host; multi-host pods all run this
     env = initialize()

@@ -18,7 +18,7 @@ from __future__ import annotations
 import copy
 import datetime
 import fnmatch
-from typing import Any, Callable
+from typing import Callable
 
 from kubeflow_rm_tpu.controlplane.api.meta import (
     deep_get,

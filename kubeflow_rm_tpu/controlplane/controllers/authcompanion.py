@@ -34,7 +34,6 @@ import secrets
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
 from kubeflow_rm_tpu.controlplane.api.meta import (
     annotations_of,
-    deep_get,
     make_object,
     set_controller_reference,
 )

@@ -12,7 +12,6 @@ from __future__ import annotations
 from kubeflow_rm_tpu.controlplane.api.meta import (
     deep_get,
     make_object,
-    name_of,
 )
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer, NotFound
 from kubeflow_rm_tpu.controlplane.runtime import (

@@ -87,7 +87,6 @@ def jsonl_documents(paths, *, process_id: int = 0, num_processes: int = 1,
     text that ``tokenize`` maps to one.
     """
     import json as _json
-    from pathlib import Path
 
     paths = sorted(str(p) for p in paths)
     index = []  # (path_i, byte offset) per record

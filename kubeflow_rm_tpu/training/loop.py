@@ -21,7 +21,7 @@ the trainer. Design points, TPU-first:
 
 import logging
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Iterable
 
 import jax

@@ -25,7 +25,6 @@ from kubeflow_rm_tpu.controlplane.deploy.crds import all_crds, render_yaml
 from kubeflow_rm_tpu.controlplane.deploy.kubeclient import KubeAPIServer
 from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
 from kubeflow_rm_tpu.controlplane.deploy.webhook_server import (
-    AdmissionHandler,
     WebhookServer,
     json_patch,
     make_admission_handler,

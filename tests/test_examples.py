@@ -3,7 +3,6 @@
 import json
 
 import numpy as np
-import pytest
 
 
 def test_finetune_example_synthetic(capsys, tmp_path):

@@ -8,7 +8,7 @@ import pytest
 
 from kubeflow_rm_tpu.controlplane import make_control_plane
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
-from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+from kubeflow_rm_tpu.controlplane.api.meta import make_object
 from kubeflow_rm_tpu.controlplane.controllers.statefulset import make_tpu_node
 from kubeflow_rm_tpu.controlplane.webapps.core import CSRF_HEADER
 from kubeflow_rm_tpu.controlplane.webapps.jupyter import create_app

@@ -1,7 +1,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from kubeflow_rm_tpu.models import LlamaConfig, forward, init_params
 from kubeflow_rm_tpu.utils import param_count

@@ -1,7 +1,6 @@
 """Zigzag ring attention vs dense, on the 8-device CPU mesh."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -154,6 +154,11 @@ FRONTIER = [
     {"mb": 1, "remat": "dots", "accum": 8, "seq": 4096, "mfu": 56.28},
     {"mb": 2, "remat": "attn", "accum": 8, "seq": 4096, "mfu": 54.77},
     {"mb": 2, "remat": "dots", "accum": 8, "seq": 4096, "mfu": "OOM"},
+    # long context, single chip: full remat is what fits; the 32k wall
+    # is where the sp attention backends (ring/ulysses) take over
+    {"mb": 1, "remat": "full", "accum": 4, "seq": 8192, "mfu": 48.97},
+    {"mb": 1, "remat": "full", "accum": 4, "seq": 16384, "mfu": 45.11},
+    {"mb": 1, "remat": "full", "accum": 2, "seq": 32768, "mfu": "OOM"},
 ]
 
 

@@ -31,7 +31,8 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from kubeflow_rm_tpu.models.llama import LlamaConfig, _epilogue
+from kubeflow_rm_tpu.models.llama import LlamaConfig
+from kubeflow_rm_tpu.models.quantize import maybe_dequant
 from kubeflow_rm_tpu.ops import (
     apply_rope,
     dot_product_attention,
@@ -89,20 +90,23 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
         from kubeflow_rm_tpu.parallel.moe import moe_ffn
 
         def ffn(layer, h):
-            out, _aux = moe_ffn(layer, h, cfg.moe, dtype=cdt)
+            dq = {k: (maybe_dequant(v, cdt) if k.startswith("moe") else v)
+                  for k, v in layer.items()}
+            out, _aux = moe_ffn(dq, h, cfg.moe, dtype=cdt)
             return out
     else:
         def ffn(layer, h):
-            gate = h @ layer["w_gate"].astype(cdt)
-            up = h @ layer["w_up"].astype(cdt)
-            return (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cdt)
+            gate = h @ maybe_dequant(layer["w_gate"], cdt)
+            up = h @ maybe_dequant(layer["w_up"], cdt)
+            return (jax.nn.silu(gate) * up) @ maybe_dequant(
+                layer["w_down"], cdt)
 
     def body(x, scanned):
         layer, ck, cv = scanned
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"].astype(cdt)).reshape(B, Tc, H, hd)
-        k = (h @ layer["wk"].astype(cdt)).reshape(B, Tc, KVH, hd)
-        v = (h @ layer["wv"].astype(cdt)).reshape(B, Tc, KVH, hd)
+        q = (h @ maybe_dequant(layer["wq"], cdt)).reshape(B, Tc, H, hd)
+        k = (h @ maybe_dequant(layer["wk"], cdt)).reshape(B, Tc, KVH, hd)
+        v = (h @ maybe_dequant(layer["wv"], cdt)).reshape(B, Tc, KVH, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         ck = jax.lax.dynamic_update_slice(ck, k, (0, cache.offset, 0, 0))
@@ -111,13 +115,16 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
             q, ck, cv, causal=True,
             positions_q=positions, positions_kv=kv_positions,
         )
-        x = x + attn.reshape(B, Tc, H * hd) @ layer["wo"].astype(cdt)
+        x = x + attn.reshape(B, Tc, H * hd) @ maybe_dequant(
+            layer["wo"], cdt)
         x = x + ffn(layer, rms_norm(x, layer["mlp_norm"], cfg.norm_eps))
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["blocks"], cache.k, cache.v))
-    logits = _epilogue(params, x, cfg)
+    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    logits = (x @ maybe_dequant(params["lm_head"], cdt)
+              ).astype(jnp.float32)
     new_cache = KVCache(k=new_k, v=new_v, positions=kv_positions,
                        offset=cache.offset + Tc)
     return logits, new_cache

@@ -7,6 +7,7 @@ import jax
 from kubeflow_rm_tpu.models import llama as _llama
 from kubeflow_rm_tpu.models import mixtral as _mixtral
 from kubeflow_rm_tpu.models.convert import config_from_hf, from_hf_llama
+from kubeflow_rm_tpu.models.lora import add_lora, lora_mask, merge_lora
 from kubeflow_rm_tpu.models.quantize import maybe_dequant, quantize_params
 from kubeflow_rm_tpu.models.generate import (
     KVCache,
@@ -37,7 +38,8 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
     return _llama.forward(params, tokens, cfg, **kwargs), None
 
 
-__all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "config_from_hf",
+__all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "add_lora",
+           "config_from_hf",
            "cache_shardings", "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",
            "generate", "init_cache", "init_params", "make_decode_step",
-           "maybe_dequant", "quantize_params"]
+           "lora_mask", "maybe_dequant", "merge_lora", "quantize_params"]

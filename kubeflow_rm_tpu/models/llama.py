@@ -61,6 +61,9 @@ class LlamaConfig:
     # "attn"/"mlp"/"attn+mlp": save the named activations only (the
     # HBM-vs-recompute middle ground — see _NAME_POLICIES).
     remat_policy: str = "dots"
+    # LoRA scaling (alpha/rank) for adapter-carrying params — see
+    # models.lora; inert when no adapter leaves are present.
+    lora_alpha: float = 16.0
     # "auto": dense attention, GSPMD inserts whatever collectives the
     # sp sharding needs (all-gather of K/V). "ring"/"ulysses": run the
     # explicit sequence-parallel schedule (parallel.ring_attention /
@@ -201,10 +204,13 @@ def _attention_half(cfg: LlamaConfig, x, layer, cos, sin, positions,
     H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     cdt = cfg.dtype
 
+    from kubeflow_rm_tpu.models.lora import lora_proj
+
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(cdt)).reshape(B, T, H, hd)
-    k = (h @ layer["wk"].astype(cdt)).reshape(B, T, KVH, hd)
-    v = (h @ layer["wv"].astype(cdt)).reshape(B, T, KVH, hd)
+    proj = partial(lora_proj, layer, alpha=cfg.lora_alpha, dtype=cdt)
+    q = proj("wq", h).reshape(B, T, H, hd)
+    k = proj("wk", h).reshape(B, T, KVH, hd)
+    v = proj("wv", h).reshape(B, T, KVH, hd)
     q = checkpoint_name(apply_rope(q, cos, sin), "q_rope")
     k = checkpoint_name(apply_rope(k, cos, sin), "k_rope")
     v = checkpoint_name(v, "v_proj")
@@ -235,7 +241,7 @@ def _attention_half(cfg: LlamaConfig, x, layer, cos, sin, positions,
             segment_ids_q=segments, segment_ids_kv=segments,
         )
     attn = checkpoint_name(attn, "attn_out")
-    return x + attn.reshape(B, T, H * hd) @ layer["wo"].astype(cdt)
+    return x + proj("wo", attn.reshape(B, T, H * hd))
 
 
 def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments,
@@ -244,12 +250,15 @@ def _block(cfg: LlamaConfig, x, layer, cos, sin, positions, segments,
     from jax.ad_checkpoint import checkpoint_name
 
     cdt = cfg.dtype
+    from kubeflow_rm_tpu.models.lora import lora_proj
+
     x = _attention_half(cfg, x, layer, cos, sin, positions, segments,
                         mesh=mesh)
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = checkpoint_name(h @ layer["w_gate"].astype(cdt), "mlp_gate")
-    up = checkpoint_name(h @ layer["w_up"].astype(cdt), "mlp_up")
-    x = x + (jax.nn.silu(gate) * up) @ layer["w_down"].astype(cdt)
+    proj = partial(lora_proj, layer, alpha=cfg.lora_alpha, dtype=cdt)
+    gate = checkpoint_name(proj("w_gate", h), "mlp_gate")
+    up = checkpoint_name(proj("w_up", h), "mlp_up")
+    x = x + proj("w_down", jax.nn.silu(gate) * up)
     return x
 
 
@@ -280,8 +289,10 @@ def _prologue(params, tokens, cfg: LlamaConfig, positions, segments,
 
 def _epilogue(params, x, cfg: LlamaConfig) -> jax.Array:
     """Shared forward epilogue: final norm, lm head, fp32 logits."""
+    from kubeflow_rm_tpu.models.quantize import maybe_dequant
+
     x = rms_norm(x, params["out_norm"], cfg.norm_eps)
-    logits = x @ params["lm_head"].astype(cfg.dtype)
+    logits = x @ maybe_dequant(params["lm_head"], cfg.dtype)
     return logits.astype(jnp.float32)
 
 

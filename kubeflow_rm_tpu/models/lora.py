@@ -1,0 +1,121 @@
+"""LoRA: low-rank adapter fine-tuning for the Llama family.
+
+The practical way to fine-tune a 7B-class model on a small slice (or
+ONE v5e chip): freeze the base weights, train rank-r adapters on the
+attention projections. Memory drops from "params + grads + 2 adam
+moments for 7B" to "frozen params + a few M adapter floats + their
+moments" — ``tests/test_7b_plan.py`` proves the 7B LoRA plan fits a
+single 16 GiB v5e by AOT accounting.
+
+Design (jax-native, composes with everything already here):
+
+- **Adapters are just extra leaves** in ``params["blocks"]``
+  (``{t}_lora_a`` (L, in, r), ``{t}_lora_b`` (L, r, out), b
+  zero-initialized so step 0 is exactly the base model). The stacked
+  layer scan, FSDP/TP shardings, grad accumulation, checkpointing and
+  the pipeline schedule all apply unchanged.
+- **Freezing lives in the optimizer**: ``optax.multi_transform`` routes
+  adapter leaves to adamw and everything else to ``set_to_zero`` —
+  frozen leaves carry no moments, which is where the memory win is
+  (``training.optim.make_optimizer(train_only="lora")``).
+- **The forward applies adapters in factored form**
+  (``h @ w + (h @ a) @ b * alpha/r``) — never materializing the
+  (in, out) delta — and ``merge_lora`` folds them into the base for
+  serving (then quantize/convert/export as usual).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: classic LoRA targets: the attention projections
+DEFAULT_TARGETS = ("wq", "wv")
+
+LORA_A = "_lora_a"
+LORA_B = "_lora_b"
+
+
+def is_lora_name(name: str) -> bool:
+    return name.endswith(LORA_A) or name.endswith(LORA_B)
+
+
+def add_lora(params: dict, rank: int, *, key: jax.Array,
+             targets: tuple[str, ...] = DEFAULT_TARGETS,
+             param_dtype=None) -> dict:
+    """Return params extended with rank-``rank`` adapters on ``targets``.
+
+    ``a`` gets a small normal init, ``b`` zeros — the adapted forward
+    equals the base model until the first update (asserted in tests).
+    """
+    blocks = dict(params["blocks"])
+    keys = jax.random.split(key, len(targets))
+    for t, k in zip(targets, keys):
+        if t not in blocks:
+            raise KeyError(f"lora target {t!r} not in blocks "
+                           f"({sorted(blocks)})")
+        w = blocks[t]
+        if isinstance(w, dict):  # int8-quantized base (QLoRA recipe)
+            L, d_in, d_out = w["q"].shape
+            dt = param_dtype or jnp.bfloat16
+        else:
+            L, d_in, d_out = w.shape
+            dt = param_dtype or w.dtype
+        blocks[t + LORA_A] = (
+            jax.random.normal(k, (L, d_in, rank)) * 0.02).astype(dt)
+        blocks[t + LORA_B] = jnp.zeros((L, rank, d_out), dt)
+    return dict(params, blocks=blocks)
+
+
+def lora_proj(layer: dict, name: str, h: jax.Array, *,
+              alpha: float, dtype) -> jax.Array:
+    """``h @ w`` plus the factored adapter delta when present.
+
+    The base weight may be int8-quantized (``models.quantize``) — the
+    QLoRA-style recipe: frozen int8 base + bf16 adapters, which is what
+    fits a 7B fine-tune on one 16 GiB v5e chip."""
+    from kubeflow_rm_tpu.models.quantize import maybe_dequant
+
+    out = h @ maybe_dequant(layer[name], dtype)
+    a = layer.get(name + LORA_A)
+    if a is None:
+        return out
+    b = layer[name + LORA_B]
+    rank = a.shape[-1]
+    return out + (h @ a.astype(dtype)) @ b.astype(dtype) * (alpha / rank)
+
+
+def merge_lora(params: dict, *, alpha: float) -> dict:
+    """Fold adapters into the base weights (serving form)."""
+    from kubeflow_rm_tpu.models.quantize import is_quantized
+
+    blocks = {}
+    for k, v in params["blocks"].items():
+        if is_lora_name(k):
+            continue
+        a = params["blocks"].get(k + LORA_A)
+        if a is None:
+            blocks[k] = v
+        elif is_quantized(v):
+            raise ValueError(
+                f"cannot merge adapters into int8 base weight {k!r}: "
+                "dequantize first (maybe_dequant) or serve the adapted "
+                "model unmerged")
+        else:
+            b = params["blocks"][k + LORA_B]
+            rank = a.shape[-1]
+            delta = jnp.einsum(
+                "lir,lro->lio", a.astype(jnp.float32),
+                b.astype(jnp.float32)) * (alpha / rank)
+            blocks[k] = (v.astype(jnp.float32) + delta).astype(v.dtype)
+    return dict(params, blocks=blocks)
+
+
+def lora_mask(params: dict) -> dict:
+    """True for adapter leaves — the optimizer's trainable set."""
+
+    def mask(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else ""
+        return is_lora_name(name)
+
+    return jax.tree_util.tree_map_with_path(mask, params)

@@ -58,6 +58,22 @@ def param_pspecs(params) -> dict:
 
     def spec_for(path, leaf):
         key = _path_str(path)
+        # LoRA adapters: a carries the base weight's contract-dim
+        # sharding, b its output-dim sharding; the tiny rank axis stays
+        # replicated
+        if key.endswith("_lora_a"):
+            return P("pp", "fsdp", None)
+        if key.endswith("_lora_b"):
+            return P("pp", None, "tp")
+        # int8-quantized weights ({"q", "s"} dicts, models.quantize):
+        # q shards like the base weight; the per-output-channel scale
+        # keeps the output axis and replicates the collapsed one
+        if key.endswith("/q") or key.endswith("/s"):
+            base = _LLAMA_RULES[key.rsplit("/", 1)[0]]
+            if key.endswith("/q"):
+                return base
+            return P(*[None if i == len(base) - 2 else ax
+                       for i, ax in enumerate(base)])
         if key not in _LLAMA_RULES:
             raise KeyError(f"no sharding rule for param {key!r}")
         return _LLAMA_RULES[key]

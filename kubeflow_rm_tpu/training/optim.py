@@ -22,6 +22,10 @@ class OptimConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     mu_dtype: str = "bfloat16"
+    # "lora": train only adapter leaves (models.lora); the train step
+    # then neither computes gradients nor stores moments for the frozen
+    # base — the memory shape that fits 7B fine-tuning on one chip
+    train_only: str | None = None
 
 
 def _decay_mask(params):

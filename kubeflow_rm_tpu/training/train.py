@@ -44,9 +44,54 @@ class TrainState:
     opt_state: Any
 
 
-def init_train_state(cfg: TrainConfig, key: jax.Array) -> TrainState:
-    params = init_params(cfg.model, key)
-    opt_state = make_optimizer(cfg.optim).init(params)
+class _Partition:
+    """Split a param tree into trainable/frozen leaf lists by a mask
+    (``optim.train_only``): the train step differentiates ONLY the
+    trainable list, so frozen weights get neither gradient buffers nor
+    optimizer moments — the memory shape LoRA fine-tuning needs."""
+
+    def __init__(self, params, mask_tree):
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.mask = jax.tree_util.tree_leaves(mask_tree)
+        assert len(self.mask) == len(leaves)
+        if not any(self.mask):
+            raise ValueError("train_only matched no parameters")
+
+    def split(self, params):
+        leaves = jax.tree_util.tree_leaves(params)
+        train = [p for p, m in zip(leaves, self.mask) if m]
+        frozen = [p for p, m in zip(leaves, self.mask) if not m]
+        return train, frozen
+
+    def combine(self, train, frozen):
+        it_t, it_f = iter(train), iter(frozen)
+        leaves = [next(it_t) if m else next(it_f) for m in self.mask]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+def _partition_for(cfg: TrainConfig, params) -> _Partition | None:
+    if cfg.optim.train_only is None:
+        return None
+    if cfg.optim.train_only != "lora":
+        raise ValueError(
+            f"unknown train_only={cfg.optim.train_only!r} (only 'lora')")
+    from kubeflow_rm_tpu.models.lora import lora_mask
+    return _Partition(params, lora_mask(params))
+
+
+def init_train_state(cfg: TrainConfig, key: jax.Array,
+                     params=None) -> TrainState:
+    """Fresh state; pass ``params`` to seed from existing weights (an
+    HF conversion, or ``models.lora.add_lora`` output for adapter
+    training)."""
+    if params is None:
+        params = init_params(cfg.model, key)
+    part = _partition_for(cfg, params)
+    opt = make_optimizer(cfg.optim)
+    if part is None:
+        opt_state = opt.init(params)
+    else:
+        opt_state = opt.init(part.split(params)[0])
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       opt_state=opt_state)
 
@@ -136,7 +181,24 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
     sshard = state_shardings(cfg, state, mesh)
     bshard = {k: NamedSharding(mesh, batch_pspec()) for k in batch_keys}
     mshard = NamedSharding(mesh, P())
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    part = _partition_for(cfg, state.params)
+
+    if part is None:
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    else:
+        # differentiate ONLY the trainable leaves: the backward never
+        # materializes base-weight gradients (dW = h^T g outer products
+        # are the dominant bwd memory/flops for a frozen 7B)
+        def _loss_trainable(train, frozen, batch, cfg, mesh, n_mb):
+            return loss_fn(part.combine(train, frozen), batch, cfg,
+                           mesh, n_mb)
+
+        _grad_trainable = jax.value_and_grad(_loss_trainable,
+                                             has_aux=True)
+
+        def grad_fn(params, batch, cfg, mesh, n_mb):
+            train, frozen = part.split(params)
+            return _grad_trainable(train, frozen, batch, cfg, mesh, n_mb)
 
     def fold(a):
         # interleaved: microbatch m takes rows m, K+m, ... so the fold
@@ -161,8 +223,9 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
                                      n_microbatches)
             return jax.tree_util.tree_map(jnp.add, acc, g), (loss, aux)
 
+        grad_target = params if part is None else part.split(params)[0]
         zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, p.dtype), params)
+            lambda p: jnp.zeros(p.shape, p.dtype), grad_target)
         summed, (losses, auxes) = jax.lax.scan(body, zeros, folded)
         grads = jax.tree_util.tree_map(lambda g: g / grad_accum, summed)
         loss = jnp.mean(losses)
@@ -175,8 +238,13 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
         else:
             (loss, aux), grads = grad_fn(
                 state.params, batch, cfg, mesh, n_microbatches)
-        updates, opt_state = opt.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        if part is None:
+            target, frozen = state.params, None
+        else:
+            target, frozen = part.split(state.params)
+        updates, opt_state = opt.update(grads, state.opt_state, target)
+        target = optax.apply_updates(target, updates)
+        params = target if part is None else part.combine(target, frozen)
         gnorm = optax.global_norm(grads)
         metrics = {"loss": loss, "grad_norm": gnorm, **aux}
         return TrainState(step=state.step + 1, params=params,

@@ -48,6 +48,12 @@ def main(argv=None) -> int:
     ap.add_argument("--fsdp", type=int, default=0,
                     help="0 = all local devices")
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="train rank-r adapters instead of full "
+                         "fine-tuning (frozen base: no grads/moments)")
+    ap.add_argument("--int8-base", action="store_true",
+                    help="with --lora-rank: quantize the frozen base "
+                         "to int8 (the 7B-on-one-v5e recipe)")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--export-hf", default=None,
                     help="write the tuned weights as an HF state_dict "
@@ -64,6 +70,7 @@ def main(argv=None) -> int:
     from kubeflow_rm_tpu.parallel import MeshConfig, make_mesh
     from kubeflow_rm_tpu.parallel.distributed import initialize
     from kubeflow_rm_tpu.training import TrainConfig
+    from kubeflow_rm_tpu.training.optim import OptimConfig
     from kubeflow_rm_tpu.training.data import (
         device_prefetch, jsonl_documents, packed_batches,
         synthetic_batches,
@@ -87,12 +94,20 @@ def main(argv=None) -> int:
         from kubeflow_rm_tpu.models import from_hf_llama
         hf = transformers.LlamaForCausalLM.from_pretrained(args.hf_model)
         model_cfg, params = from_hf_llama(hf)
-        cfg = TrainConfig(model=model_cfg)
-        state = None  # init below, seeded from the converted params
     else:
-        cfg = TrainConfig(model=getattr(LlamaConfig, args.preset)())
+        model_cfg = getattr(LlamaConfig, args.preset)()
         params = None
-        state = None
+    optim = OptimConfig(train_only="lora" if args.lora_rank else None)
+    cfg = TrainConfig(model=model_cfg, optim=optim)
+    state = None  # built below once params are final
+    if args.lora_rank:
+        from kubeflow_rm_tpu.models import add_lora, init_params
+        if params is None:
+            params = init_params(model_cfg, jax.random.key(0))
+        if args.int8_base:
+            from kubeflow_rm_tpu.models import quantize_params
+            params = quantize_params(params)
+        params = add_lora(params, args.lora_rank, key=jax.random.key(1))
 
     # 3. the data
     if args.data:
@@ -109,11 +124,8 @@ def main(argv=None) -> int:
 
     # 4. train (fit restores from checkpoint_dir when present)
     if params is not None:
-        import jax.numpy as jnp
-
-        from kubeflow_rm_tpu.training.optim import make_optimizer
-        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                           opt_state=make_optimizer(cfg.optim).init(params))
+        from kubeflow_rm_tpu.training.train import init_train_state
+        state = init_train_state(cfg, jax.random.key(0), params=params)
     loop = LoopConfig(total_steps=args.steps,
                       log_every=max(1, args.steps // 10),
                       checkpoint_dir=args.checkpoint_dir,
@@ -125,7 +137,7 @@ def main(argv=None) -> int:
         print(f"final: step {last.step} loss {last.loss:.4f} "
               f"{last.tokens_per_sec:.0f} tok/s mfu {last.mfu_pct:.1f}%")
 
-    # 5. sample
+    # 5. sample — decode applies adapters and int8 bases directly
     if args.sample and env.process_id == 0:
         prompt = np.ones((1, 4), np.int32)
         out = generate(state.params, cfg.model,

@@ -27,11 +27,13 @@ capability the jupyter-jax image adds on top (SURVEY.md §2.6).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 from kubeflow_rm_tpu.models.llama import LlamaConfig
+from kubeflow_rm_tpu.models.lora import lora_proj
 from kubeflow_rm_tpu.models.quantize import maybe_dequant
 from kubeflow_rm_tpu.ops import (
     apply_rope,
@@ -96,17 +98,19 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
             return out
     else:
         def ffn(layer, h):
-            gate = h @ maybe_dequant(layer["w_gate"], cdt)
-            up = h @ maybe_dequant(layer["w_up"], cdt)
-            return (jax.nn.silu(gate) * up) @ maybe_dequant(
-                layer["w_down"], cdt)
+            proj = partial(lora_proj, layer, alpha=cfg.lora_alpha,
+                           dtype=cdt)
+            gate = proj("w_gate", h)
+            up = proj("w_up", h)
+            return proj("w_down", jax.nn.silu(gate) * up)
 
     def body(x, scanned):
         layer, ck, cv = scanned
+        proj = partial(lora_proj, layer, alpha=cfg.lora_alpha, dtype=cdt)
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ maybe_dequant(layer["wq"], cdt)).reshape(B, Tc, H, hd)
-        k = (h @ maybe_dequant(layer["wk"], cdt)).reshape(B, Tc, KVH, hd)
-        v = (h @ maybe_dequant(layer["wv"], cdt)).reshape(B, Tc, KVH, hd)
+        q = proj("wq", h).reshape(B, Tc, H, hd)
+        k = proj("wk", h).reshape(B, Tc, KVH, hd)
+        v = proj("wv", h).reshape(B, Tc, KVH, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         ck = jax.lax.dynamic_update_slice(ck, k, (0, cache.offset, 0, 0))
@@ -115,8 +119,7 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
             q, ck, cv, causal=True,
             positions_q=positions, positions_kv=kv_positions,
         )
-        x = x + attn.reshape(B, Tc, H * hd) @ maybe_dequant(
-            layer["wo"], cdt)
+        x = x + proj("wo", attn.reshape(B, Tc, H * hd))
         x = x + ffn(layer, rms_norm(x, layer["mlp_norm"], cfg.norm_eps))
         return x, (ck, cv)
 

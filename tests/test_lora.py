@@ -60,6 +60,9 @@ def test_lora_train_freezes_base_and_learns(base, devices8):
         model=cfg_model,
         optim=OptimConfig(learning_rate=1e-2, warmup_steps=2,
                           total_steps=100, train_only="lora"))
+    # deep-copy: the step donates its input state, and add_lora shares
+    # leaf references with the module-scoped fixture
+    params = jax.tree_util.tree_map(jnp.array, params)
     lparams = add_lora(params, rank=4, key=jax.random.key(1))
     mask = lora_mask(lparams)
     # the first step donates the state buffers: snapshot to host first
@@ -113,6 +116,9 @@ def test_qlora_int8_base_trains(base, devices8):
     from kubeflow_rm_tpu.models.quantize import quantize_params
 
     cfg_model, params = base
+    # deep-copy: norms/embed pass through quantize by reference, and
+    # the step donates its input state
+    params = jax.tree_util.tree_map(jnp.array, params)
     qbase = quantize_params(params)
     lparams = add_lora(qbase, rank=4, key=jax.random.key(1))
     cfg = TrainConfig(
@@ -137,6 +143,39 @@ def test_qlora_int8_base_trains(base, devices8):
     # merging into an int8 base is refused with guidance
     with pytest.raises(ValueError, match="int8 base"):
         merge_lora(state.params, alpha=cfg_model.lora_alpha)
+
+
+def test_adapted_decode_matches_merged(base):
+    """generate()/decode apply adapters in factored form — the unmerged
+    decode must equal decoding the merged weights."""
+    from kubeflow_rm_tpu.models.generate import decode_chunk, init_cache
+
+    cfg, params = base
+    lparams = add_lora(params, rank=4, key=jax.random.key(1))
+    lparams["blocks"]["wv_lora_b"] = (
+        jax.random.normal(jax.random.key(5),
+                          lparams["blocks"]["wv_lora_b"].shape) * 0.1)
+    tokens = jax.random.randint(jax.random.key(6), (1, 10), 0,
+                                cfg.vocab_size)
+    adapted, _ = decode_chunk(lparams, cfg, init_cache(cfg, 1, 10),
+                              tokens)
+    merged = merge_lora(lparams, alpha=cfg.lora_alpha)
+    ref, _ = decode_chunk(merged, cfg, init_cache(cfg, 1, 10), tokens)
+    np.testing.assert_allclose(np.asarray(adapted), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_example_qlora_smoke(capsys, tmp_path):
+    """The example's QLoRA flags drive the whole recipe end to end."""
+    from examples.finetune_llama import main
+
+    rc = main(["--preset", "tiny", "--steps", "3", "--batch", "8",
+               "--seq-len", "32", "--fsdp", "4",
+               "--lora-rank", "4", "--int8-base"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "final: step 3" in out
+    assert "sample token ids:" in out
 
 
 def test_train_only_without_adapters_fails_loudly(base):

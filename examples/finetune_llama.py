@@ -55,6 +55,9 @@ def main(argv=None) -> int:
                     help="with --lora-rank: quantize the frozen base "
                          "to int8 (the 7B-on-one-v5e recipe)")
     ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--tb-logdir", default=None,
+                    help="write tensorboard events here (point a "
+                         "Tensorboard CR at the same pvc:// path)")
     ap.add_argument("--export-hf", default=None,
                     help="write the tuned weights as an HF state_dict "
                          "(.npz) here")
@@ -130,8 +133,12 @@ def main(argv=None) -> int:
                       log_every=max(1, args.steps // 10),
                       checkpoint_dir=args.checkpoint_dir,
                       grad_accum=args.grad_accum)
+    callbacks = ()
+    if args.tb_logdir and env.process_id == 0:
+        from kubeflow_rm_tpu.utils.tensorboard import TensorboardCallback
+        callbacks = (TensorboardCallback(args.tb_logdir),)
     state, history = fit(cfg, mesh, batches, loop, state=state,
-                         batch_keys=batch_keys)
+                         batch_keys=batch_keys, callbacks=callbacks)
     if history:
         last = history[-1]
         print(f"final: step {last.step} loss {last.loss:.4f} "

@@ -155,6 +155,14 @@ def set_tpu(nb: dict, body: dict, defaults: dict) -> None:
             f"acceleratorType {accel!r} is not offered by this "
             f"deployment's spawner config")
     nb["spec"]["tpu"] = {"acceleratorType": topo.accelerator_type}
+    # multislice: N ICI slices joined over DCN (MEGASCALE_* rendezvous
+    # comes from the webhook; the controller renders hosts x N pods)
+    num_slices = tpu.get("numSlices", 1)
+    if not isinstance(num_slices, int) or num_slices < 1:
+        raise BadRequest(
+            f"tpu.numSlices must be an int >= 1, got {num_slices!r}")
+    if num_slices > 1:
+        nb["spec"]["tpu"]["numSlices"] = num_slices
 
 
 def set_tolerations(nb: dict, body: dict, defaults: dict) -> None:

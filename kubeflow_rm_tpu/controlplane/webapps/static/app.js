@@ -323,6 +323,11 @@ route(/^\/notebooks\/new$/, async () => {
                 · ${esc(t.chips)} chips / ${esc(t.hosts)} hosts</span>`).join("")}
           </div>
           <p class="hint">Only slice types present in the cluster inventory are offered.</p>
+          <div class="row" id="f-multislice" hidden>
+            <label for="f-numslices">Slices (DCN-joined)</label>
+            <input type="number" id="f-numslices" value="1" min="1"
+                   max="16" style="width: 5em">
+          </div>
         </div>
         <div class="field">
           <label><input type="checkbox" id="f-workspace" checked>
@@ -430,6 +435,7 @@ route(/^\/notebooks\/new$/, async () => {
     for (const c of document.querySelectorAll(".slice-chip")) {
       c.classList.toggle("selected", c === chip);
     }
+    $("#f-multislice").hidden = accel === "none";
   };
 
   $("#spawn").onsubmit = async (ev) => {
@@ -463,7 +469,10 @@ route(/^\/notebooks\/new$/, async () => {
       serverType,
       cpu: $("#f-cpu").value,
       memory: $("#f-memory").value,
-      tpu: accel === "none" ? null : { acceleratorType: accel },
+      tpu: accel === "none" ? null : {
+        acceleratorType: accel,
+        numSlices: parseInt($("#f-numslices").value, 10) || 1,
+      },
       tolerationGroup: $("#f-tolerations").value,
       affinityConfig: $("#f-affinity").value,
       configurations: [...document.querySelectorAll(".f-poddefault:checked")]

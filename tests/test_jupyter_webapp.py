@@ -258,6 +258,39 @@ def test_pods_and_per_ordinal_logs(stack, app):
     assert resp.status_code == 403
 
 
+def test_multislice_spawn_through_form(stack, app):
+    """numSlices in the form body: the controller renders hosts x N
+    pods and the webhook stamps the MEGASCALE DCN rendezvous on each
+    (the multislice path end-to-end through the web API)."""
+    api, mgr = stack
+    for i in range(2, 4):  # 2 more v5p-16 hosts: 2 slices x 2 hosts
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    client = app.test_client(user=USER)
+    resp = post_json(
+        client, "/api/namespaces/team/notebooks",
+        spawn_body(tpu={"acceleratorType": "v5p-16", "numSlices": 2}))
+    assert resp.status_code == 200, resp.get_data()
+    mgr.run_until_idle()
+
+    pods = json.loads(client.get(
+        "/api/namespaces/team/notebooks/mynb/pods").get_data())["pods"]
+    assert len(pods) == 4  # 2 slices x 2 hosts
+    raw = [p for p in api.list("Pod", "team")]
+    for pod in raw:
+        env = {e["name"]: e.get("value")
+               for c in pod["spec"]["containers"]
+               for e in c.get("env", [])}
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] in ("0", "1")
+
+    # garbage numSlices -> 400
+    resp = post_json(
+        client, "/api/namespaces/team/notebooks",
+        spawn_body(name="bad",
+                   tpu={"acceleratorType": "v5p-16", "numSlices": 0}))
+    assert resp.status_code == 400
+
+
 def test_pod_logs_require_notebook_ownership(stack, app):
     """A pod that merely shares the '<notebook>-<ordinal>' name shape but
     is not labelled as belonging to the notebook must not be readable

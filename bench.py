@@ -32,7 +32,11 @@ def main(argv=None) -> None:
     ap.add_argument("--accum", type=int, default=None,
                     help="gradient-accumulation microbatch count")
     ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--decode", action="store_true",
+                    help="benchmark decode (loop vs fused scan) instead")
     args = ap.parse_args(argv)
+    if args.decode:
+        return decode_bench()
 
     import jax
     import jax.numpy as jnp
@@ -139,6 +143,53 @@ def main(argv=None) -> None:
         # default run: carry the audited frontier (BENCH_SWEEP_r04.json)
         out["frontier"] = FRONTIER
     print(json.dumps(out))
+
+
+def decode_bench() -> None:
+    """Loop-vs-fused decode throughput (``--decode``): the per-token
+    jit dispatch of ``generate`` against the single-program
+    ``generate_fused`` scan, same bf16 bench-1b weights and cache."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_rm_tpu.models import (
+        LlamaConfig, generate, generate_fused, init_params,
+    )
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig.bench_1b(param_dtype=jnp.bfloat16)
+        B, Tp, new = 4, 128, 384
+    else:
+        cfg = LlamaConfig.tiny()
+        B, Tp, new = 2, 8, 16
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (B, Tp), 0,
+                                cfg.vocab_size)
+
+    def timed(fn):
+        out = fn()          # compile + warm
+        jax.device_get(out[:, -1])
+        t0 = time.perf_counter()
+        out = fn()
+        jax.device_get(out[:, -1])
+        return time.perf_counter() - t0
+
+    t_loop = timed(lambda: generate(
+        params, cfg, prompt, max_new_tokens=new))
+    t_fused = timed(lambda: generate_fused(
+        params, cfg, prompt, max_new_tokens=new))
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec",
+        "value": round(B * new / t_fused, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(t_loop / t_fused, 2),
+        "batch": B, "prefill": Tp, "new_tokens": new,
+        "loop_ms_per_token": round(1e3 * t_loop / new, 2),
+        "fused_ms_per_token": round(1e3 * t_fused / new, 2),
+        "speedup": round(t_loop / t_fused, 2),
+    }))
 
 
 #: the r4 config sweep, measured on one v5e chip (fresh process each;

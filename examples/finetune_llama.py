@@ -69,7 +69,7 @@ def main(argv=None) -> int:
     import jax
     import numpy as np
 
-    from kubeflow_rm_tpu.models import LlamaConfig, generate
+    from kubeflow_rm_tpu.models import LlamaConfig, generate_fused
     from kubeflow_rm_tpu.parallel import MeshConfig, make_mesh
     from kubeflow_rm_tpu.parallel.distributed import initialize
     from kubeflow_rm_tpu.training import TrainConfig
@@ -147,8 +147,8 @@ def main(argv=None) -> int:
     # 5. sample — decode applies adapters and int8 bases directly
     if args.sample and env.process_id == 0:
         prompt = np.ones((1, 4), np.int32)
-        out = generate(state.params, cfg.model,
-                       jax.numpy.asarray(prompt), max_new_tokens=8)
+        out = generate_fused(state.params, cfg.model,
+                             jax.numpy.asarray(prompt), max_new_tokens=8)
         print("sample token ids:", np.asarray(out)[0].tolist())
 
     # 6. export

@@ -89,6 +89,12 @@ def tpu_spec(notebook: dict) -> tpu_api.SliceTopology | None:
     return tpu_api.lookup(t["acceleratorType"])
 
 
+#: schema-level cap on multislice width — one request may render at most
+#: hosts-per-slice × MAX_SLICES pods, so an unbounded value would let a
+#: single authenticated POST fan the controller out arbitrarily wide
+MAX_SLICES = 64
+
+
 def num_slices(notebook: dict) -> int:
     """Multislice width (1 = a single ICI-connected slice; >1 = a DCN
     job of identical slices, rendered as one gang-scheduled pool)."""
@@ -115,5 +121,6 @@ def validate(notebook: dict) -> None:
             raise ValueError("spec.tpu requires acceleratorType")
         tpu_api.lookup(t["acceleratorType"])  # raises on unknown
         ns = t.get("numSlices", 1)
-        if not isinstance(ns, int) or ns < 1:
-            raise ValueError("spec.tpu.numSlices must be an int >= 1")
+        if not isinstance(ns, int) or ns < 1 or ns > MAX_SLICES:
+            raise ValueError(
+                f"spec.tpu.numSlices must be an int in [1, {MAX_SLICES}]")

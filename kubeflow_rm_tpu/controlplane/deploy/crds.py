@@ -16,6 +16,7 @@ never drift from what the controller schedules.
 from __future__ import annotations
 
 from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api.notebook import MAX_SLICES
 
 # Free-form object: pod specs / quota specs / plugin configs — CRDs
 # model these as x-kubernetes-preserve-unknown-fields, exactly how the
@@ -81,6 +82,7 @@ def notebook_crd() -> dict:
             "numSlices": {
                 "type": "integer",
                 "minimum": 1,
+                "maximum": MAX_SLICES,
                 "description": "Multislice width: >1 renders a DCN job "
                                "of identical slices with MEGASCALE_* "
                                "rendezvous injected.",

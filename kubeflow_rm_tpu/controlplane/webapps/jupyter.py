@@ -158,9 +158,11 @@ def set_tpu(nb: dict, body: dict, defaults: dict) -> None:
     # multislice: N ICI slices joined over DCN (MEGASCALE_* rendezvous
     # comes from the webhook; the controller renders hosts x N pods)
     num_slices = tpu.get("numSlices", 1)
-    if not isinstance(num_slices, int) or num_slices < 1:
+    if (not isinstance(num_slices, int) or num_slices < 1
+            or num_slices > nb_api.MAX_SLICES):
         raise BadRequest(
-            f"tpu.numSlices must be an int >= 1, got {num_slices!r}")
+            f"tpu.numSlices must be an int in "
+            f"[1, {nb_api.MAX_SLICES}], got {num_slices!r}")
     if num_slices > 1:
         nb["spec"]["tpu"]["numSlices"] = num_slices
 

@@ -14,6 +14,7 @@ from kubeflow_rm_tpu.models.generate import (
     cache_shardings,
     decode_chunk,
     generate,
+    generate_fused,
     init_cache,
     make_decode_step,
 )
@@ -41,5 +42,6 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
 __all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "add_lora",
            "config_from_hf",
            "cache_shardings", "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",
-           "generate", "init_cache", "init_params", "make_decode_step",
+           "generate", "generate_fused", "init_cache", "init_params",
+           "make_decode_step",
            "lora_mask", "maybe_dequant", "merge_lora", "quantize_params"]

@@ -174,6 +174,90 @@ def make_decode_step(example_params: dict, cfg: LlamaConfig, mesh):
     )
 
 
+def _pick(last, key, *, temperature, top_k):
+    """Next-token choice from last-position logits (B, V): greedy
+    argmax at temperature 0, else (top-k-truncated) categorical. The
+    single source for BOTH decode paths — ``generate`` and
+    ``_fused_generate`` must sample identically or the fused path's
+    greedy bit-identity guarantee silently breaks."""
+    if temperature <= 0:
+        return jnp.argmax(last, axis=-1).astype(jnp.int32)
+    scaled = last / temperature
+    if top_k:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def _decode_step(params, cfg, cache, tokens):
+    """Module-level jitted ``decode_chunk``: one cache entry per
+    (config, shapes), shared across ``generate`` calls — a per-call
+    ``jax.jit(lambda ...)`` would be a fresh cache key every time and
+    re-trace + re-compile on every generation."""
+    return decode_chunk(params, cfg, cache, tokens)
+
+
+@partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "temperature", "top_k", "eos_id",
+    "total_len"))
+def _fused_generate(params, prompt, key, *, cfg, max_new_tokens,
+                    temperature, top_k, eos_id, total_len):
+    B, _ = prompt.shape
+    cache = init_cache(cfg, B, total_len)
+    logits, cache = decode_chunk(params, cfg, cache, prompt)
+    last = logits[:, -1, :]
+
+    def body(carry, k_i):
+        cache, last, done = carry
+        nxt = _pick(last, k_i, temperature=temperature, top_k=top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        logits, cache = decode_chunk(params, cfg, cache, nxt[:, None])
+        return (cache, logits[:, -1, :], done), nxt
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _, _), toks = jax.lax.scan(
+        body, (cache, last, jnp.zeros((B,), bool)), keys)
+    return jnp.concatenate([prompt, toks.T], axis=1)
+
+
+def generate_fused(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
+                   max_new_tokens: int, key: jax.Array | None = None,
+                   temperature: float = 0.0, top_k: int | None = None,
+                   eos_id: int | None = None,
+                   max_len: int | None = None) -> jax.Array:
+    """``generate`` as ONE compiled XLA program.
+
+    The Python-loop ``generate`` dispatches a jitted step per token —
+    ~10 ms/token of host round-trip when the chip sits behind a network
+    tunnel, which dwarfs the ~1 ms of decode compute. Here the whole
+    prefill + ``lax.scan`` decode loop (sampling, eos latching, cache
+    updates included) lowers to a single jit, so dispatch cost is paid
+    once per generation instead of once per token. Greedy output is
+    bit-identical to ``generate``; at ``temperature > 0`` the PRNG
+    stream differs (keys are pre-split for the scan), which is the only
+    behavioral difference.
+
+    The scan runs exactly ``max_new_tokens`` steps; the final step's
+    cache write is dead work (~1/N overhead) — the price of a
+    shape-static loop, which is what keeps the whole thing one program.
+    """
+    B, Tp = prompt.shape
+    S = max_len or (Tp + max_new_tokens)
+    if S < Tp + max_new_tokens:
+        raise ValueError(
+            f"max_len={S} < prompt {Tp} + new {max_new_tokens}")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    return _fused_generate(
+        params, prompt, key if key is not None else jax.random.key(0),
+        cfg=cfg, max_new_tokens=max_new_tokens,
+        temperature=float(temperature), top_k=top_k, eos_id=eos_id,
+        total_len=S)
+
+
 def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
              max_new_tokens: int, key: jax.Array | None = None,
              temperature: float = 0.0, top_k: int | None = None,
@@ -194,24 +278,13 @@ def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
 
-    # params as a jit ARGUMENT, never a closure: captured weights would
-    # be baked into the lowered module as constants (a multi-GB HLO for
-    # real models, observed to wedge remote-compile paths)
-    step = jax.jit(lambda p, c, t: decode_chunk(p, cfg, c, t),
-                   donate_argnums=(1,))
-
+    # params ride as a jit ARGUMENT of the shared _decode_step, never a
+    # closure: captured weights would be baked into the lowered module
+    # as constants (a multi-GB HLO for real models, observed to wedge
+    # remote-compile paths)
     cache = init_cache(cfg, B, S)
-    logits, cache = step(params, cache, prompt)
+    logits, cache = _decode_step(params, cfg, cache, prompt)
     last = logits[:, -1, :]
-
-    def pick(last, k):
-        if temperature <= 0:
-            return jnp.argmax(last, axis=-1).astype(jnp.int32)
-        scaled = last / temperature
-        if top_k:
-            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-        return jax.random.categorical(k, scaled).astype(jnp.int32)
 
     out = [prompt]
     done = jnp.zeros((B,), bool)
@@ -220,12 +293,12 @@ def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
             key, sub = jax.random.split(key)
         else:
             sub = None
-        nxt = pick(last, sub)
+        nxt = _pick(last, sub, temperature=temperature, top_k=top_k)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
         out.append(nxt[:, None])
         if i + 1 < max_new_tokens:
-            logits, cache = step(params, cache, nxt[:, None])
+            logits, cache = _decode_step(params, cfg, cache, nxt[:, None])
             last = logits[:, -1, :]
     return jnp.concatenate(out, axis=1)

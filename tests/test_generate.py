@@ -163,6 +163,56 @@ def test_moe_decode_matches_forward():
                                atol=1e-4)
 
 
+def test_fused_greedy_matches_loop_generate(model):
+    """The single-program scan decode must be bit-identical to the
+    per-token loop under greedy decoding (same argmax chain)."""
+    from kubeflow_rm_tpu.models.generate import generate_fused
+
+    cfg, params = model
+    prompt = jax.random.randint(jax.random.key(9), (2, 6), 0,
+                                cfg.vocab_size)
+    loop = generate(params, cfg, prompt, max_new_tokens=7)
+    fused = generate_fused(params, cfg, prompt, max_new_tokens=7)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(loop))
+
+
+def test_fused_eos_latch_and_sampling_shape(model):
+    from kubeflow_rm_tpu.models.generate import generate_fused
+
+    cfg, params = model
+    prompt = jnp.ones((2, 4), jnp.int32)
+    out = generate_fused(params, cfg, prompt, max_new_tokens=8,
+                         key=jax.random.key(1), temperature=1.0, top_k=5)
+    assert out.shape == (2, 12)
+    logits = forward(params, prompt, cfg)
+    eos = int(jnp.argmax(logits[0, -1]))
+    out = generate_fused(params, cfg, prompt, max_new_tokens=4,
+                         eos_id=eos)
+    row = np.asarray(out[0, 4:])
+    assert row[0] == eos and (row == eos).all()
+    with pytest.raises(ValueError, match="PRNG key"):
+        generate_fused(params, cfg, prompt, max_new_tokens=1,
+                       temperature=0.5)
+
+
+def test_fused_moe_greedy_matches_loop():
+    """Family dispatch inside the fused scan: Mixtral decodes too."""
+    from dataclasses import replace
+
+    from kubeflow_rm_tpu.models import init_params as init_any
+    from kubeflow_rm_tpu.models.generate import generate_fused
+    from kubeflow_rm_tpu.models.mixtral import MixtralConfig
+
+    cfg = MixtralConfig.tiny_moe()
+    cfg = replace(cfg, moe=replace(cfg.moe, capacity_factor=8.0))
+    params = init_any(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(10), (1, 5), 0,
+                                cfg.vocab_size)
+    loop = generate(params, cfg, prompt, max_new_tokens=5)
+    fused = generate_fused(params, cfg, prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(loop))
+
+
 def test_sampling_requires_key(model):
     cfg, params = model
     with pytest.raises(ValueError, match="PRNG key"):

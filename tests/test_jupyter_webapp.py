@@ -290,6 +290,16 @@ def test_multislice_spawn_through_form(stack, app):
                    tpu={"acceleratorType": "v5p-16", "numSlices": 0}))
     assert resp.status_code == 400
 
+    # unbounded numSlices -> 400 (one POST may not fan out an arbitrary
+    # pod count; the cap is nb_api.MAX_SLICES, mirrored in the CRD)
+    from kubeflow_rm_tpu.controlplane.api.notebook import MAX_SLICES
+    resp = post_json(
+        client, "/api/namespaces/team/notebooks",
+        spawn_body(name="bad2",
+                   tpu={"acceleratorType": "v5p-16",
+                        "numSlices": MAX_SLICES + 1}))
+    assert resp.status_code == 400
+
 
 def test_pod_logs_require_notebook_ownership(stack, app):
     """A pod that merely shares the '<notebook>-<ordinal>' name shape but

@@ -65,7 +65,9 @@ def main(argv=None) -> None:
         #   mb2 attn+mlp accum8            58.81
         #   mb2 dots     accum8 blk512     56.76
         #   mb2 dots     accum16           59.81
-        #   mb2 dots     accum32           60.10   <- default
+        #   mb2 dots     accum32           60.10
+        #   mb2 dots     accum64           60.36   <- default
+        #   mb2 dots     accum128          60.45   (asymptote; 2x step time)
         #   mb1 dots     accum8  seq4096   56.28
         #   mb2 attn     accum8  seq4096   54.77
         #   mb2 dots     accum8  seq4096   OOM (17.7G)
@@ -74,7 +76,7 @@ def main(argv=None) -> None:
         # ~1.2B-param adam update (pure HBM traffic, ~50 ms) across K
         # microbatch grads, and "dots" remat beats named-save once the
         # update is off the critical path (recompute is the next cost).
-        accum = 32 if args.accum is None else args.accum
+        accum = 64 if args.accum is None else args.accum
         batch = (2 * accum) if args.batch is None else args.batch
         model = LlamaConfig.bench_1b(
             param_dtype=jnp.bfloat16,
@@ -202,6 +204,8 @@ FRONTIER = [
     {"mb": 2, "remat": "dots", "accum": 8, "block": 512, "mfu": 56.76},
     {"mb": 2, "remat": "dots", "accum": 16, "mfu": 59.81},
     {"mb": 2, "remat": "dots", "accum": 32, "mfu": 60.10},
+    {"mb": 2, "remat": "dots", "accum": 64, "mfu": 60.36},
+    {"mb": 2, "remat": "dots", "accum": 128, "mfu": 60.45},
     {"mb": 1, "remat": "dots", "accum": 8, "seq": 4096, "mfu": 56.28},
     {"mb": 2, "remat": "attn", "accum": 8, "seq": 4096, "mfu": 54.77},
     {"mb": 2, "remat": "dots", "accum": 8, "seq": 4096, "mfu": "OOM"},

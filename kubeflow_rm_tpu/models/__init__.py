@@ -17,6 +17,7 @@ from kubeflow_rm_tpu.models.generate import (
     generate_fused,
     init_cache,
     make_decode_step,
+    make_generate_step,
 )
 from kubeflow_rm_tpu.models.llama import LlamaConfig, forward
 from kubeflow_rm_tpu.models.mixtral import MixtralConfig
@@ -43,5 +44,5 @@ __all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "add_lora",
            "config_from_hf",
            "cache_shardings", "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",
            "generate", "generate_fused", "init_cache", "init_params",
-           "make_decode_step",
+           "make_decode_step", "make_generate_step",
            "lora_mask", "maybe_dequant", "merge_lora", "quantize_params"]

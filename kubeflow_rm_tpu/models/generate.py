@@ -198,13 +198,17 @@ def _decode_step(params, cfg, cache, tokens):
     return decode_chunk(params, cfg, cache, tokens)
 
 
-@partial(jax.jit, static_argnames=(
-    "cfg", "max_new_tokens", "temperature", "top_k", "eos_id",
-    "total_len"))
-def _fused_generate(params, prompt, key, *, cfg, max_new_tokens,
-                    temperature, top_k, eos_id, total_len):
+def _fused_decode_loop(params, cfg, prompt, key, *, max_new_tokens,
+                       temperature, top_k, eos_id, total_len,
+                       cache_sharding=None):
+    """Trace-time body shared by ``generate_fused`` (single device) and
+    ``make_generate_step`` (sharded): prefill, then a ``lax.scan`` over
+    decode steps. ``cache_sharding`` (a NamedSharding pytree) pins the
+    freshly-initialized cache's layout under GSPMD."""
     B, _ = prompt.shape
     cache = init_cache(cfg, B, total_len)
+    if cache_sharding is not None:
+        cache = jax.lax.with_sharding_constraint(cache, cache_sharding)
     logits, cache = decode_chunk(params, cfg, cache, prompt)
     last = logits[:, -1, :]
 
@@ -221,6 +225,17 @@ def _fused_generate(params, prompt, key, *, cfg, max_new_tokens,
     (_, _, _), toks = jax.lax.scan(
         body, (cache, last, jnp.zeros((B,), bool)), keys)
     return jnp.concatenate([prompt, toks.T], axis=1)
+
+
+@partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "temperature", "top_k", "eos_id",
+    "total_len"))
+def _fused_generate(params, prompt, key, *, cfg, max_new_tokens,
+                    temperature, top_k, eos_id, total_len):
+    return _fused_decode_loop(
+        params, cfg, prompt, key, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, eos_id=eos_id,
+        total_len=total_len)
 
 
 def generate_fused(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
@@ -256,6 +271,60 @@ def generate_fused(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
         cfg=cfg, max_new_tokens=max_new_tokens,
         temperature=float(temperature), top_k=top_k, eos_id=eos_id,
         total_len=S)
+
+
+def make_generate_step(example_params: dict, cfg: LlamaConfig, mesh, *,
+                       max_new_tokens: int, total_len: int,
+                       temperature: float = 0.0, top_k: int | None = None,
+                       eos_id: int | None = None):
+    """Sharded ``generate_fused``: one compiled SPMD program per mesh.
+
+    Returns ``(params, prompt, key=None) -> tokens`` (a jitted SPMD
+    program behind a thin argument-contract check) where params
+    carry their training shardings (serve on an fsdp×tp mesh, like
+    ``make_decode_step``), the prompt and result tokens are
+    batch-sharded over (dp, fsdp), and the KV cache lives its whole
+    life inside the program on ``cache_shardings`` — it is never
+    materialized on the host. Greedy output matches the single-device
+    ``generate_fused`` exactly (``tests/test_generate.py``).
+
+    ``example_params`` is only inspected for the pytree structure.
+    """
+    from jax.sharding import NamedSharding
+
+    from kubeflow_rm_tpu.parallel.sharding import (
+        batch_pspec, param_shardings,
+    )
+
+    def run(params, prompt, key):
+        return _fused_decode_loop(
+            params, cfg, prompt, key, max_new_tokens=max_new_tokens,
+            temperature=float(temperature), top_k=top_k, eos_id=eos_id,
+            total_len=total_len,
+            cache_sharding=cache_shardings(cfg, mesh))
+
+    jitted = jax.jit(
+        run,
+        in_shardings=(param_shardings(example_params, mesh),
+                      NamedSharding(mesh, batch_pspec(False)), None),
+        out_shardings=NamedSharding(mesh, batch_pspec(False)))
+
+    def step(params, prompt, key=None):
+        # same argument contract as generate_fused: cache must fit the
+        # generation (an undersized cache would silently clamp
+        # dynamic_update_slice writes into the last slot), and greedy
+        # decoding works without a key
+        if total_len < prompt.shape[1] + max_new_tokens:
+            raise ValueError(
+                f"total_len={total_len} < prompt {prompt.shape[1]} + "
+                f"new {max_new_tokens}")
+        if temperature > 0 and key is None:
+            raise ValueError(
+                "sampling (temperature > 0) requires a PRNG key")
+        return jitted(params, prompt,
+                      key if key is not None else jax.random.key(0))
+
+    return step
 
 
 def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,

@@ -213,6 +213,37 @@ def test_fused_moe_greedy_matches_loop():
     np.testing.assert_array_equal(np.asarray(fused), np.asarray(loop))
 
 
+def test_sharded_fused_generate_matches_single_device(model, devices8):
+    """make_generate_step on a dp×fsdp×tp mesh: the whole generation is
+    one SPMD program (cache never leaves the device) and greedy output
+    must equal the single-device fused path."""
+    from kubeflow_rm_tpu.models.generate import (
+        generate_fused, make_generate_step,
+    )
+    from kubeflow_rm_tpu.parallel import MeshConfig, make_mesh
+
+    cfg, params = model
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2), devices8)
+    prompt = jax.random.randint(jax.random.key(11), (4, 6), 0,
+                                cfg.vocab_size)
+    ref = generate_fused(params, cfg, prompt, max_new_tokens=5,
+                         max_len=11)
+    step = make_generate_step(params, cfg, mesh, max_new_tokens=5,
+                              total_len=11)
+    got = step(params, prompt)  # greedy needs no key
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    with pytest.raises(ValueError, match="total_len"):
+        step(params, jnp.ones((4, 9), jnp.int32))
+    with pytest.raises(ValueError, match="PRNG key"):
+        make_generate_step(params, cfg, mesh, max_new_tokens=2,
+                           total_len=12, temperature=0.5)(params, prompt)
+    # sampling path compiles and keeps shape on the same mesh
+    step_s = make_generate_step(params, cfg, mesh, max_new_tokens=3,
+                                total_len=9, temperature=0.9, top_k=7)
+    out = step_s(params, prompt, jax.random.key(2))
+    assert out.shape == (4, 9)
+
+
 def test_sampling_requires_key(model):
     cfg, params = model
     with pytest.raises(ValueError, match="PRNG key"):

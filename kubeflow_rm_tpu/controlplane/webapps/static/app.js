@@ -89,6 +89,77 @@ function statusCell(st) {
     <span class="dot ${esc(phase)}"></span>${esc(phase)}</span>`;
 }
 
+// ---- resource-table controls: sort + filter --------------------------
+// the reference's shared Angular resource-table ships column sorting
+// and a quick text filter; same semantics here, shared by the
+// notebooks / volumes / tensorboards list views. Views call
+// tableControls(card, columns) once after rendering their skeleton,
+// then pipe fetched items through .apply() on every render.
+
+function qty(s) {
+  // kubernetes quantity -> number, so Size columns sort by magnitude
+  // (lexicographic order would put 10Gi before 5Gi)
+  const m = /^([0-9.]+)([KMGTPE]i?|[kmun])?$/.exec(String(s || "").trim());
+  if (!m) return 0;
+  const mult = { k: 1e3, K: 1e3, M: 1e6, G: 1e9, T: 1e12, P: 1e15,
+    E: 1e18, m: 1e-3, u: 1e-6, n: 1e-9,
+    Ki: 2 ** 10, Mi: 2 ** 20, Gi: 2 ** 30, Ti: 2 ** 40,
+    Pi: 2 ** 50, Ei: 2 ** 60 }[m[2]] || 1;
+  return parseFloat(m[1]) * mult;
+}
+
+function tableControls(card, columns) {
+  // columns: key -> accessor, or key -> {text, sort}. The text
+  // accessor MUST return what the cell displays (the filter matches
+  // against it); sort may differ (e.g. qty() for Size columns).
+  const cols = {};
+  for (const [k, v] of Object.entries(columns)) {
+    cols[k] = typeof v === "function" ? { text: v, sort: v }
+      : { text: v.text, sort: v.sort || v.text };
+  }
+  const tc = { sortKey: null, dir: 1, q: "", onchange: null };
+  const input = document.createElement("input");
+  input.type = "search";
+  input.placeholder = "filter…";
+  input.className = "table-filter";
+  card.querySelector("table").before(input);
+  input.addEventListener("input", () => {
+    tc.q = input.value.toLowerCase();
+    if (tc.onchange) tc.onchange();
+  });
+  const thead = card.querySelector("thead");
+  thead.addEventListener("click", (ev) => {
+    const th = ev.target.closest("th[data-sort]");
+    if (!th) return;
+    const key = th.dataset.sort;
+    if (tc.sortKey === key) tc.dir = -tc.dir;
+    else { tc.sortKey = key; tc.dir = 1; }
+    for (const h of thead.querySelectorAll("th[data-sort]")) {
+      h.textContent = h.textContent.replace(/ [▲▼]$/, "");
+      if (h.dataset.sort === tc.sortKey) {
+        h.textContent += tc.dir > 0 ? " ▲" : " ▼";
+      }
+    }
+    if (tc.onchange) tc.onchange();
+  });
+  tc.apply = (items) => {
+    let out = items;
+    if (tc.q) {
+      out = out.filter((it) => Object.values(cols).some((c) =>
+        String(c.text(it) ?? "").toLowerCase().includes(tc.q)));
+    }
+    if (tc.sortKey) {
+      const acc = cols[tc.sortKey].sort;
+      out = [...out].sort((a, b) => {
+        const va = acc(a) ?? "", vb = acc(b) ?? "";
+        return (va > vb ? 1 : va < vb ? -1 : 0) * tc.dir;
+      });
+    }
+    return out;
+  };
+  return tc;
+}
+
 // ---- router ----------------------------------------------------------
 
 const routes = [];
@@ -193,20 +264,38 @@ route(/^\/notebooks$/, async () => {
         <button class="primary" id="new-nb">+ New Notebook</button>
       </div>
       <table>
-        <thead><tr><th>Status</th><th>Name</th><th>Image</th>
-          <th>TPU slice</th><th>Age</th><th></th></tr></thead>
+        <thead><tr><th data-sort="status">Status</th>
+          <th data-sort="name">Name</th><th data-sort="image">Image</th>
+          <th data-sort="tpu">TPU slice</th><th data-sort="age">Age</th>
+          <th></th></tr></thead>
         <tbody id="nb-rows"></tbody>
       </table>
     </div>`;
   $("#new-nb").onclick = () => { location.hash = "#/notebooks/new"; };
 
+  const tpuText = (nb) => nb.tpu
+    ? `${nb.tpu.acceleratorType} · ${nb.tpu.chips} chips / ${nb.tpu.hosts} hosts`
+    : "none";
+  const tc = tableControls(view.querySelector(".card"), {
+    status: (nb) => nb.status?.phase || "",
+    name: (nb) => nb.name,
+    image: (nb) => (nb.image || "").split("/").pop(),
+    tpu: tpuText,
+    age: { text: (nb) => age(nb.age), sort: (nb) => nb.age || "" },
+  });
+  let items = [];
+  tc.onchange = () => render();
+
   async function refresh() {
     const data = await get(`/jupyter/api/namespaces/${ns}/notebooks`);
-    const rows = data.notebooks.map((nb) => {
+    items = data.notebooks;
+    render();
+  }
+
+  function render() {
+    const rows = tc.apply(items).map((nb) => {
       const stopped = nb.status?.phase === "stopped";
-      const tpu = nb.tpu
-        ? `${nb.tpu.acceleratorType} · ${nb.tpu.chips} chips / ${nb.tpu.hosts} hosts`
-        : "none";
+      const tpu = tpuText(nb);
       return `<tr class="clickable" data-name="${esc(nb.name)}">
         <td>${statusCell(nb.status)}</td>
         <td><b>${esc(nb.name)}</b></td>
@@ -597,15 +686,32 @@ route(/^\/volumes$/, async () => {
       <h2>Volumes</h2>
       <p class="sub">PersistentVolumeClaims in <b>${esc(ns)}</b></p>
       <table>
-        <thead><tr><th>Name</th><th>Size</th><th>Access</th>
-          <th>Used by</th><th>Viewer</th><th></th></tr></thead>
+        <thead><tr><th data-sort="name">Name</th>
+          <th data-sort="size">Size</th><th data-sort="access">Access</th>
+          <th data-sort="usedby">Used by</th><th>Viewer</th><th></th>
+        </tr></thead>
         <tbody id="pvc-rows"></tbody>
       </table>
     </div>`;
 
+  const tc = tableControls(view.querySelector(".card"), {
+    name: (r) => r.pvc.metadata.name,
+    size: { text: (r) => r.pvc.spec?.resources?.requests?.storage || "",
+            sort: (r) => qty(r.pvc.spec?.resources?.requests?.storage) },
+    access: (r) => (r.pvc.spec?.accessModes || []).join(","),
+    usedby: (r) => r.inUseBy.join(", ") || "—",
+  });
+  let items = [];
+  tc.onchange = () => render();
+
   async function refresh() {
     const data = await get(`/volumes/api/namespaces/${ns}/pvcs`);
-    $("#pvc-rows").innerHTML = data.pvcs
+    items = data.pvcs;
+    render();
+  }
+
+  function render() {
+    $("#pvc-rows").innerHTML = tc.apply(items)
       .map((row) => {
         const pvc = row.pvc;
         const name = pvc.metadata.name;
@@ -661,8 +767,9 @@ route(/^\/tensorboards$/, async () => {
       <h2>Tensorboards</h2>
       <p class="sub">Serving from PVC or GCS log dirs in <b>${esc(ns)}</b></p>
       <table>
-        <thead><tr><th>Status</th><th>Name</th><th>Logspath</th>
-          <th>Age</th><th></th></tr></thead>
+        <thead><tr><th data-sort="status">Status</th>
+          <th data-sort="name">Name</th><th data-sort="logspath">Logspath</th>
+          <th data-sort="age">Age</th><th></th></tr></thead>
         <tbody id="tb-rows"></tbody>
       </table>
     </div>
@@ -677,9 +784,23 @@ route(/^\/tensorboards$/, async () => {
       </form>
     </div>`;
 
+  const tc = tableControls(view.querySelector(".card"), {
+    status: (tb) => tb.status?.phase || "",
+    name: (tb) => tb.name,
+    logspath: (tb) => tb.logspath || "",
+    age: { text: (tb) => age(tb.age), sort: (tb) => tb.age || "" },
+  });
+  let items = [];
+  tc.onchange = () => render();
+
   async function refresh() {
     const data = await get(`/tensorboards/api/namespaces/${ns}/tensorboards`);
-    $("#tb-rows").innerHTML = data.tensorboards
+    items = data.tensorboards;
+    render();
+  }
+
+  function render() {
+    $("#tb-rows").innerHTML = tc.apply(items)
       .map((tb) => `<tr data-name="${esc(tb.name)}">
           <td>${statusCell(tb.status)}</td>
           <td><b>${esc(tb.name)}</b></td>

@@ -107,8 +107,19 @@ def drive(url: str, headed: bool = False) -> None:
         expect(page.locator("#d-logs")).to_contain_text(
             "joining jax.distributed")
 
-        # stop: phase flips to stopped (culling path's UI affordance)
+        # resource-table controls: the filter narrows rows, a header
+        # click sorts (indicator appears) — the kubeflow-common-lib
+        # resource-table semantics
         page.goto(f"{url}/#/notebooks")
+        page.fill(".table-filter", "no-such-notebook")
+        expect(page.locator(f'tr[data-name="{nb}"]')).to_have_count(0)
+        page.fill(".table-filter", nb[:4])
+        expect(page.locator(f'tr[data-name="{nb}"]')).to_be_visible()
+        page.fill(".table-filter", "")
+        page.click('th[data-sort="name"]')
+        expect(page.locator('th[data-sort="name"]')).to_contain_text("▲")
+
+        # stop: phase flips to stopped (culling path's UI affordance)
         page.click(f'tr[data-name="{nb}"] button[data-act="stop"]')
         expect(page.locator(f'tr[data-name="{nb}"] .status')
                ).to_contain_text("stopped", timeout=30_000)

@@ -65,6 +65,101 @@ def gateway_client(api, user=USER):
     return C()
 
 
+def _js_structure_check(src: str) -> None:
+    """Bracket-balance lexer for app.js: string/template/comment/regex
+    aware. No JS engine ships in this image (the browser e2e lane runs
+    in CI only), so this is the strongest static guard against an edit
+    that unbalances a brace and takes down the whole SPA."""
+    stack = []            # open brackets (char, offset) + "${" markers
+    mode = ["code"]       # code | template
+    last_sig = ""         # last significant char (regex-vs-divide)
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if mode[-1] == "template":
+            if c == "\\":
+                i += 2
+                continue
+            if c == "`":
+                mode.pop()
+                i += 1
+                continue
+            if c == "$" and i + 1 < n and src[i + 1] == "{":
+                stack.append(("${", i))
+                mode.append("code")
+                i += 2
+                continue
+            i += 1
+            continue
+        if c == "/" and src.startswith("//", i):
+            nl = src.find("\n", i)
+            i = n if nl < 0 else nl
+            continue
+        if c == "/" and src.startswith("/*", i):
+            end = src.find("*/", i)
+            assert end > 0, f"unterminated block comment at {i}"
+            i = end + 2
+            continue
+        if c in "'\"":
+            j = i + 1
+            while j < n and src[j] != c:
+                j += 2 if src[j] == "\\" else 1
+            assert j < n, f"unterminated string at {i}"
+            i, last_sig = j + 1, c
+            continue
+        if c == "`":
+            mode.append("template")
+            i += 1
+            continue
+        if c == "/" and (last_sig in "(,=:[!&|?{};>+-*%~^" or not last_sig
+                         or re.search(r"\b(return|typeof|case|in|of|new|"
+                                      r"delete|void|instanceof|yield|"
+                                      r"await|do|else)$",
+                                      src[:i].rstrip())):
+            # try a regex literal; if no closing "/" before the newline
+            # this was division after all — fall through, consuming
+            # only the one "/" (heuristic must never fail valid code)
+            j, in_class = i + 1, False
+            while j < n and src[j] != "\n" and (in_class or src[j] != "/"):
+                if src[j] == "\\":
+                    j += 1
+                elif src[j] == "[":
+                    in_class = True
+                elif src[j] == "]":
+                    in_class = False
+                j += 1
+            if j < n and src[j] == "/":
+                i, last_sig = j + 1, "/"
+                continue
+        if c in "([{":
+            stack.append((c, i))
+        elif c in ")]}":
+            if c == "}" and stack and stack[-1][0] == "${":
+                stack.pop()
+                assert mode.pop() == "code"
+            else:
+                assert stack, f"unmatched {c!r} at {i}"
+                o, at = stack.pop()
+                pairs = {"(": ")", "[": "]", "{": "}"}
+                assert pairs[o] == c, (
+                    f"{o!r} at {at} closed by {c!r} at {i}")
+        if not c.isspace():
+            last_sig = c
+        i += 1
+    assert not stack, f"unclosed {stack[-1][0]!r} at {stack[-1][1]}"
+    assert mode == ["code"], "unterminated template literal"
+
+
+def test_app_js_brackets_balanced():
+    # negative controls: the checker must actually catch breakage
+    for bad in ("function f() { if (x) { g(); }",
+                "const s = `a ${b ? 'x' : 'y'`;",
+                "f(]"):
+        with pytest.raises(AssertionError):
+            _js_structure_check(bad)
+    _js_structure_check((STATIC / "app.js").read_text())
+
+
 # ---- SPA shell -------------------------------------------------------
 
 def test_index_serves_spa_and_sets_csrf_cookie(stack):

@@ -1,0 +1,319 @@
+#!/usr/bin/env python3
+"""Serve a Llama from the slice this notebook was spawned with.
+
+The inference-side counterpart of ``finetune_llama.py``: a small HTTP
+server around the single-program decode path (``generate_fused`` /
+``make_generate_step``), meant to run inside a jupyter-jax notebook or
+as the command of a spawned serving pod. The reference platform ships
+no model runtime at all (SURVEY.md §2.6) — serving is capability the
+TPU image adds on top.
+
+TPU-shaped choices:
+
+- **Micro-batching.** Requests arriving within a batching window are
+  padded into one fixed-shape ``generate_fused`` call — decode is
+  HBM-bandwidth-bound, so tokens/sec scales nearly free with batch.
+- **Shape buckets.** Prompts pad up to power-of-two buckets and
+  ``max_new_tokens`` is server-fixed, so XLA compiles a handful of
+  programs once instead of one per request shape.
+- **Token ids in/out.** The API speaks token ids (JSON lists);
+  tokenization happens client-side (or pass ``--hf-tokenizer`` to
+  decode text server-side when the files are available).
+
+API: ``POST /generate {"prompt": [ids...], "max_new_tokens"?: n,
+"temperature"?: t, "top_k"?: k}`` → ``{"tokens": [ids...]}``;
+``GET /healthz``.
+
+Tiny smoke (CPU, what tests/test_examples.py runs):
+    python examples/serve_llama.py --preset tiny --selftest
+Real chip:
+    python examples/serve_llama.py --preset llama2_7b \
+        --hf-model meta-llama/Llama-2-7b-hf --int8 --port 8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import queue
+import sys
+import threading
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class Batcher:
+    """Collects concurrent generate requests into fixed-shape batches.
+
+    One background thread drains the queue: it waits for the first
+    request, then up to ``window_ms`` for stragglers (bounded by
+    ``max_batch``), pads all prompts (left-pad with ``pad_id``, which
+    doubles as a "begin" token) into the smallest power-of-two bucket,
+    and runs ONE fused generation for the whole batch. Each waiter
+    gets its row back, trimmed of padding.
+    """
+
+    def __init__(self, step_fn, *, max_new_tokens: int, pad_id: int = 0,
+                 window_ms: float = 5.0, max_batch: int = 8,
+                 rows_multiple: int = 1):
+        # step_fn: (ids (B,T), pad_counts (B,), temperature, top_k)
+        #          -> (B, T+new)
+        self.step_fn = step_fn
+        self.max_new_tokens = max_new_tokens
+        self.pad_id = pad_id
+        self.window_ms = window_ms
+        self.max_batch = max_batch
+        # sharded batches must divide the mesh's data axes: dummy rows
+        # (copies of row 0) round B up, and only real rows are returned
+        self.rows_multiple = rows_multiple
+        self.q: queue.Queue = queue.Queue()
+        self.batches_run = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, prompt: list[int], temperature: float = 0.0,
+               top_k: int | None = None) -> list[int]:
+        """Blocking: returns prompt + continuation token ids."""
+        done = threading.Event()
+        box: dict = {"prompt": prompt, "temperature": temperature,
+                     "top_k": top_k, "done": done}
+        self.q.put(box)
+        done.wait()
+        if "error" in box:
+            raise RuntimeError(box["error"])
+        return box["result"]
+
+    def close(self):
+        self._stop.set()
+        self.q.put(None)
+        self._thread.join(timeout=5)
+
+    def _run(self):
+        import numpy as np
+
+        while not self._stop.is_set():
+            first = self.q.get()
+            if first is None:
+                continue
+            batch = [first]
+            # sampling params are per-BATCH shape keys: only coalesce
+            # requests that share them (others wait for the next cycle)
+            deadline = time.monotonic() + self.window_ms / 1e3
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self.q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                if (nxt["temperature"] == first["temperature"]
+                        and nxt["top_k"] == first["top_k"]):
+                    batch.append(nxt)
+                else:
+                    self.q.put(nxt)
+                    break
+
+            # EVERYTHING per-batch lives under try: an assembly error
+            # (e.g. an int that overflows int32) must fail the batch's
+            # waiters, never kill this thread — a dead drain thread
+            # would hang every future request forever
+            try:
+                lens = [len(b["prompt"]) for b in batch]
+                T = _bucket(max(lens))
+                B = (-(-len(batch) // self.rows_multiple)
+                     * self.rows_multiple)
+                ids = np.full((B, T), self.pad_id, np.int32)
+                for i, b in enumerate(batch):
+                    ids[i, T - lens[i]:] = b["prompt"]   # left-pad
+                for i in range(len(batch), B):           # dummy rows
+                    ids[i] = ids[0]
+                pads = np.asarray(
+                    [T - ln for ln in lens] +
+                    [T - lens[0]] * (B - len(batch)), np.int32)
+                out = np.asarray(self.step_fn(
+                    ids, pads, first["temperature"], first["top_k"]))
+                self.batches_run += 1
+                for i, b in enumerate(batch):
+                    row = out[i, T - lens[i]:].tolist()
+                    b["result"] = row
+                    b["done"].set()
+            except Exception as e:  # propagate to every waiter
+                for b in batch:
+                    b["error"] = repr(e)
+                    b["done"].set()
+
+
+def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
+             window_ms: float = 5.0, max_batch: int = 8,
+             tokenizer=None):
+    """werkzeug WSGI app + its Batcher. ``mesh`` switches the backend
+    to the sharded ``make_generate_step`` program."""
+    import jax
+    import numpy as np
+    from werkzeug.exceptions import BadRequest, HTTPException
+    from werkzeug.routing import Map, Rule
+    from werkzeug.wrappers import Request, Response
+
+    from kubeflow_rm_tpu.models import generate_fused, make_generate_step
+
+    steps = {}  # (total_len, temperature, top_k) -> sharded step
+
+    def step_fn(ids, pad_counts, temperature, top_k):
+        B, T = ids.shape
+        S = T + max_new_tokens
+        key = jax.random.key(0) if temperature <= 0 else \
+            jax.random.key(np.random.randint(0, 2**31 - 1))
+        if mesh is None:
+            return generate_fused(
+                params, cfg, ids, max_new_tokens=max_new_tokens,
+                key=key, temperature=temperature, top_k=top_k,
+                max_len=S, pad_counts=pad_counts)
+        if (S, temperature, top_k) not in steps:
+            if len(steps) >= 16:   # bound compile accumulation
+                steps.pop(next(iter(steps)))
+            steps[(S, temperature, top_k)] = make_generate_step(
+                params, cfg, mesh, max_new_tokens=max_new_tokens,
+                total_len=S, temperature=temperature, top_k=top_k)
+        return steps[(S, temperature, top_k)](params, ids, key,
+                                              pad_counts)
+
+    rows = 1
+    if mesh is not None:
+        rows = int(mesh.shape["dp"] * mesh.shape["fsdp"])
+    batcher = Batcher(step_fn, max_new_tokens=max_new_tokens,
+                      window_ms=window_ms, max_batch=max_batch,
+                      rows_multiple=rows)
+
+    urls = Map([Rule("/generate", endpoint="generate",
+                     methods=["POST"]),
+                Rule("/healthz", endpoint="healthz")])
+
+    def app(environ, start_response):
+        req = Request(environ)
+        try:
+            endpoint, _ = urls.bind_to_environ(environ).match()
+            if endpoint == "healthz":
+                resp = Response(json.dumps({"ok": True}),
+                                content_type="application/json")
+                return resp(environ, start_response)
+            body = req.get_json(force=True)
+            if tokenizer is not None and "text" in body:
+                prompt = tokenizer.encode(body["text"])
+            else:
+                prompt = body.get("prompt")
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int)
+                               and 0 <= t < cfg.vocab_size
+                               for t in prompt)):
+                raise BadRequest("prompt must be a non-empty list of "
+                                 f"token ids in [0, {cfg.vocab_size}) "
+                                 "(or pass text with a server-side "
+                                 "tokenizer)")
+            if len(prompt) > cfg.max_seq_len - max_new_tokens:
+                raise BadRequest(f"prompt too long ({len(prompt)}); "
+                                 f"limit {cfg.max_seq_len - max_new_tokens}")
+            temp = body.get("temperature", 0.0)
+            if not isinstance(temp, (int, float)) or not 0 <= temp <= 10:
+                raise BadRequest("temperature must be a number in "
+                                 "[0, 10]")
+            # sampling params are compile keys (static in the fused
+            # program): snap temperature to a 0.05 grid so hostile or
+            # chatty clients can't force one XLA compile per request
+            temp = round(float(temp) * 20) / 20
+            top_k = body.get("top_k")
+            if top_k is not None and (
+                    not isinstance(top_k, int)
+                    or not 1 <= top_k <= cfg.vocab_size):
+                raise BadRequest("top_k must be an int in "
+                                 f"[1, {cfg.vocab_size}]")
+            tokens = batcher.submit(prompt, temp, top_k)
+            out = {"tokens": tokens}
+            if tokenizer is not None:
+                out["text"] = tokenizer.decode(tokens)
+            resp = Response(json.dumps(out),
+                            content_type="application/json")
+        except HTTPException as e:
+            resp = e
+        return resp(environ, start_response)
+
+    app.batcher = batcher
+    return app
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="tiny")
+    ap.add_argument("--hf-model", default=None)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 quantize before serving")
+    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--fsdp", type=int, default=0,
+                    help="0 = all local devices (with --tp 1 ⇒ "
+                         "single-device fused path when 1 device)")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--selftest", action="store_true",
+                    help="serve in-process, run one batched round "
+                         "trip, exit")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from kubeflow_rm_tpu.models import (
+        LlamaConfig, from_hf_llama, init_params, quantize_params,
+    )
+    from kubeflow_rm_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = getattr(LlamaConfig, args.preset)()
+    if args.hf_model:
+        cfg, params = from_hf_llama(args.hf_model, cfg)
+    else:
+        params = init_params(cfg, jax.random.key(0))
+    if args.int8:
+        params = quantize_params(params)
+
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1 or args.tp > 1:
+        fsdp = args.fsdp or max(1, n_dev // args.tp)
+        mesh = make_mesh(MeshConfig(fsdp=fsdp, tp=args.tp))
+
+    app = make_app(cfg, params, max_new_tokens=args.max_new_tokens,
+                   mesh=mesh, max_batch=args.max_batch)
+
+    if args.selftest:
+        from werkzeug.test import Client
+        c = Client(app)
+        r = c.post("/generate", json={"prompt": [1, 2, 3]})
+        assert r.status_code == 200, r.get_data()
+        toks = r.get_json()["tokens"]
+        assert len(toks) == 3 + args.max_new_tokens
+        print(f"selftest ok: {len(toks)} tokens, "
+              f"{app.batcher.batches_run} batch(es)")
+        app.batcher.close()
+        return 0
+
+    from werkzeug.serving import make_server
+    httpd = make_server("0.0.0.0", args.port, app, threaded=True)
+    print(f"serving {args.preset} on :{args.port} "
+          f"(mesh={'1 device' if mesh is None else dict(zip(mesh.axis_names, mesh.devices.shape))})",
+          flush=True)
+    httpd.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

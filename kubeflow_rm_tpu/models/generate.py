@@ -65,12 +65,23 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> KVCache:
 
 
 def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
-                 tokens: jax.Array) -> tuple[jax.Array, KVCache]:
+                 tokens: jax.Array,
+                 pad_counts: jax.Array | None = None,
+                 ) -> tuple[jax.Array, KVCache]:
     """Run ``tokens`` (B, Tc) through the model at the cache offset.
 
     One function serves prefill (Tc = prompt length) and decode
     (Tc = 1). Returns (logits (B, Tc, V) fp32, updated cache). The
     chunk must fit: offset + Tc <= cache length.
+
+    ``pad_counts`` (B,) enables ragged batches under static shapes —
+    the serving path's requirement: row *i*'s first ``pad_counts[i]``
+    slots are left-padding. Pad slots get position ``_UNFILLED``, so
+    the standard causal mask excludes them from every later query
+    (their garbage K/V is invisible), and real tokens' positions are
+    shifted down so each row's first real token sits at position 0 —
+    batched left-padded output is bit-identical to running each row
+    unpadded (``tests/test_generate.py``).
     """
     B, Tc = tokens.shape
     H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -78,7 +89,13 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
 
     positions = cache.offset + jnp.arange(Tc, dtype=jnp.int32)
     positions = jnp.broadcast_to(positions, (B, Tc))
-    cos, sin = rope_angles(positions, hd, cfg.rope_theta)
+    if pad_counts is not None:
+        positions = positions - pad_counts[:, None]
+        positions = jnp.where(positions < 0, _UNFILLED, positions)
+    # rope of a ~2^31 position is finite but wild; clamp pads to 0
+    # (their K is masked out by the _UNFILLED position anyway)
+    rope_pos = jnp.where(positions == _UNFILLED, 0, positions)
+    cos, sin = rope_angles(rope_pos, hd, cfg.rope_theta)
     kv_positions = jax.lax.dynamic_update_slice(
         cache.positions, positions, (0, cache.offset))
 
@@ -200,7 +217,7 @@ def _decode_step(params, cfg, cache, tokens):
 
 def _fused_decode_loop(params, cfg, prompt, key, *, max_new_tokens,
                        temperature, top_k, eos_id, total_len,
-                       cache_sharding=None):
+                       cache_sharding=None, pad_counts=None):
     """Trace-time body shared by ``generate_fused`` (single device) and
     ``make_generate_step`` (sharded): prefill, then a ``lax.scan`` over
     decode steps. ``cache_sharding`` (a NamedSharding pytree) pins the
@@ -209,7 +226,7 @@ def _fused_decode_loop(params, cfg, prompt, key, *, max_new_tokens,
     cache = init_cache(cfg, B, total_len)
     if cache_sharding is not None:
         cache = jax.lax.with_sharding_constraint(cache, cache_sharding)
-    logits, cache = decode_chunk(params, cfg, cache, prompt)
+    logits, cache = decode_chunk(params, cfg, cache, prompt, pad_counts)
     last = logits[:, -1, :]
 
     def body(carry, k_i):
@@ -218,7 +235,8 @@ def _fused_decode_loop(params, cfg, prompt, key, *, max_new_tokens,
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
-        logits, cache = decode_chunk(params, cfg, cache, nxt[:, None])
+        logits, cache = decode_chunk(params, cfg, cache, nxt[:, None],
+                                     pad_counts)
         return (cache, logits[:, -1, :], done), nxt
 
     keys = jax.random.split(key, max_new_tokens)
@@ -230,19 +248,21 @@ def _fused_decode_loop(params, cfg, prompt, key, *, max_new_tokens,
 @partial(jax.jit, static_argnames=(
     "cfg", "max_new_tokens", "temperature", "top_k", "eos_id",
     "total_len"))
-def _fused_generate(params, prompt, key, *, cfg, max_new_tokens,
-                    temperature, top_k, eos_id, total_len):
+def _fused_generate(params, prompt, key, pad_counts=None, *, cfg,
+                    max_new_tokens, temperature, top_k, eos_id,
+                    total_len):
     return _fused_decode_loop(
         params, cfg, prompt, key, max_new_tokens=max_new_tokens,
         temperature=temperature, top_k=top_k, eos_id=eos_id,
-        total_len=total_len)
+        total_len=total_len, pad_counts=pad_counts)
 
 
 def generate_fused(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
                    max_new_tokens: int, key: jax.Array | None = None,
                    temperature: float = 0.0, top_k: int | None = None,
                    eos_id: int | None = None,
-                   max_len: int | None = None) -> jax.Array:
+                   max_len: int | None = None,
+                   pad_counts: jax.Array | None = None) -> jax.Array:
     """``generate`` as ONE compiled XLA program.
 
     The Python-loop ``generate`` dispatches a jitted step per token —
@@ -258,6 +278,11 @@ def generate_fused(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
     The scan runs exactly ``max_new_tokens`` steps; the final step's
     cache write is dead work (~1/N overhead) — the price of a
     shape-static loop, which is what keeps the whole thing one program.
+
+    ``pad_counts`` (B,) marks each row's leading slots as left-padding
+    for ragged batches: masked out of attention and position-shifted
+    so output rows are bit-identical to unpadded per-row calls (the
+    serving batcher's correctness contract — see ``decode_chunk``).
     """
     B, Tp = prompt.shape
     S = max_len or (Tp + max_new_tokens)
@@ -268,6 +293,7 @@ def generate_fused(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
     return _fused_generate(
         params, prompt, key if key is not None else jax.random.key(0),
+        pad_counts,
         cfg=cfg, max_new_tokens=max_new_tokens,
         temperature=float(temperature), top_k=top_k, eos_id=eos_id,
         total_len=S)
@@ -290,26 +316,29 @@ def make_generate_step(example_params: dict, cfg: LlamaConfig, mesh, *,
 
     ``example_params`` is only inspected for the pytree structure.
     """
-    from jax.sharding import NamedSharding
+    from jax.sharding import NamedSharding, PartitionSpec
 
     from kubeflow_rm_tpu.parallel.sharding import (
         batch_pspec, param_shardings,
     )
 
-    def run(params, prompt, key):
+    def run(params, prompt, key, pad_counts):
         return _fused_decode_loop(
             params, cfg, prompt, key, max_new_tokens=max_new_tokens,
             temperature=float(temperature), top_k=top_k, eos_id=eos_id,
             total_len=total_len,
-            cache_sharding=cache_shardings(cfg, mesh))
+            cache_sharding=cache_shardings(cfg, mesh),
+            pad_counts=pad_counts)
 
+    batch_rows = NamedSharding(mesh, PartitionSpec(("dp", "fsdp")))
     jitted = jax.jit(
         run,
         in_shardings=(param_shardings(example_params, mesh),
-                      NamedSharding(mesh, batch_pspec(False)), None),
+                      NamedSharding(mesh, batch_pspec(False)), None,
+                      batch_rows),
         out_shardings=NamedSharding(mesh, batch_pspec(False)))
 
-    def step(params, prompt, key=None):
+    def step(params, prompt, key=None, pad_counts=None):
         # same argument contract as generate_fused: cache must fit the
         # generation (an undersized cache would silently clamp
         # dynamic_update_slice writes into the last slot), and greedy
@@ -321,8 +350,11 @@ def make_generate_step(example_params: dict, cfg: LlamaConfig, mesh, *,
         if temperature > 0 and key is None:
             raise ValueError(
                 "sampling (temperature > 0) requires a PRNG key")
+        if pad_counts is None:
+            pad_counts = jnp.zeros((prompt.shape[0],), jnp.int32)
         return jitted(params, prompt,
-                      key if key is not None else jax.random.key(0))
+                      key if key is not None else jax.random.key(0),
+                      pad_counts)
 
     return step
 

@@ -25,7 +25,8 @@ import time
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=None,
-                    help="GLOBAL batch (microbatch = batch / accum)")
+                    help="GLOBAL batch (microbatch = batch / accum); "
+                         "with --decode, the decode batch size")
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--remat", default=None,
                     help="remat policy (dots/attn/mlp/attn+mlp/full)")
@@ -36,7 +37,7 @@ def main(argv=None) -> None:
                     help="benchmark decode (loop vs fused scan) instead")
     args = ap.parse_args(argv)
     if args.decode:
-        return decode_bench()
+        return decode_bench(args.batch)
 
     import jax
     import jax.numpy as jnp
@@ -147,10 +148,12 @@ def main(argv=None) -> None:
     print(json.dumps(out))
 
 
-def decode_bench() -> None:
+def decode_bench(batch=None) -> None:
     """Loop-vs-fused decode throughput (``--decode``): the per-token
     jit dispatch of ``generate`` against the single-program
-    ``generate_fused`` scan, same bf16 bench-1b weights and cache."""
+    ``generate_fused`` scan, same bf16 bench-1b weights and cache.
+    ``--batch`` scales the decode batch (HBM-bandwidth-bound: tokens/s
+    should rise nearly linearly until the cache+weights saturate)."""
     import jax
     import jax.numpy as jnp
 
@@ -162,10 +165,10 @@ def decode_bench() -> None:
     on_tpu = devices[0].platform == "tpu"
     if on_tpu:
         cfg = LlamaConfig.bench_1b(param_dtype=jnp.bfloat16)
-        B, Tp, new = 4, 128, 384
+        B, Tp, new = batch or 4, 128, 384
     else:
         cfg = LlamaConfig.tiny()
-        B, Tp, new = 2, 8, 16
+        B, Tp, new = batch or 2, 8, 16
     params = init_params(cfg, jax.random.key(0))
     prompt = jax.random.randint(jax.random.key(1), (B, Tp), 0,
                                 cfg.vocab_size)

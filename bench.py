@@ -35,9 +35,11 @@ def main(argv=None) -> None:
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--decode", action="store_true",
                     help="benchmark decode (loop vs fused scan) instead")
+    ap.add_argument("--quant", choices=["int8", "int4"], default=None,
+                    help="with --decode: weight-only quantize first")
     args = ap.parse_args(argv)
     if args.decode:
-        return decode_bench(args.batch)
+        return decode_bench(args.batch, args.quant)
 
     import jax
     import jax.numpy as jnp
@@ -148,7 +150,7 @@ def main(argv=None) -> None:
     print(json.dumps(out))
 
 
-def decode_bench(batch=None) -> None:
+def decode_bench(batch=None, quant=None) -> None:
     """Loop-vs-fused decode throughput (``--decode``): the per-token
     jit dispatch of ``generate`` against the single-program
     ``generate_fused`` scan, same bf16 bench-1b weights and cache.
@@ -170,6 +172,9 @@ def decode_bench(batch=None) -> None:
         cfg = LlamaConfig.tiny()
         B, Tp, new = batch or 2, 8, 16
     params = init_params(cfg, jax.random.key(0))
+    if quant:
+        from kubeflow_rm_tpu.models import quantize_params
+        params = quantize_params(params, bits=4 if quant == "int4" else 8)
     prompt = jax.random.randint(jax.random.key(1), (B, Tp), 0,
                                 cfg.vocab_size)
 
@@ -194,6 +199,7 @@ def decode_bench(batch=None) -> None:
         "loop_ms_per_token": round(1e3 * t_loop / new, 2),
         "fused_ms_per_token": round(1e3 * t_fused / new, 2),
         "speedup": round(t_loop / t_fused, 2),
+        **({"quant": quant} if quant else {}),
     }))
 
 

@@ -51,9 +51,14 @@ def main(argv=None) -> int:
     ap.add_argument("--lora-rank", type=int, default=0,
                     help="train rank-r adapters instead of full "
                          "fine-tuning (frozen base: no grads/moments)")
-    ap.add_argument("--int8-base", action="store_true",
-                    help="with --lora-rank: quantize the frozen base "
-                         "to int8 (the 7B-on-one-v5e recipe)")
+    qbase = ap.add_mutually_exclusive_group()
+    qbase.add_argument("--int8-base", action="store_true",
+                       help="with --lora-rank: quantize the frozen "
+                            "base to int8 (the 7B-on-one-v5e recipe)")
+    qbase.add_argument("--int4-base", action="store_true",
+                       help="with --lora-rank: pack the frozen base "
+                            "to int4 (~3.6 GB for 7B — the "
+                            "QLoRA-style maximum-headroom recipe)")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--tb-logdir", default=None,
                     help="write tensorboard events here (point a "
@@ -107,9 +112,10 @@ def main(argv=None) -> int:
         from kubeflow_rm_tpu.models import add_lora, init_params
         if params is None:
             params = init_params(model_cfg, jax.random.key(0))
-        if args.int8_base:
+        if args.int8_base or args.int4_base:
             from kubeflow_rm_tpu.models import quantize_params
-            params = quantize_params(params)
+            params = quantize_params(
+                params, bits=4 if args.int4_base else 8)
         params = add_lora(params, args.lora_rank, key=jax.random.key(1))
 
     # 3. the data

@@ -256,8 +256,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--preset", default="tiny")
     ap.add_argument("--hf-model", default=None)
-    ap.add_argument("--int8", action="store_true",
-                    help="weight-only int8 quantize before serving")
+    quant = ap.add_mutually_exclusive_group()
+    quant.add_argument("--int8", action="store_true",
+                       help="weight-only int8 quantize before serving")
+    quant.add_argument("--int4", action="store_true",
+                       help="weight-only packed-int4 quantize "
+                            "(smallest HBM footprint; per-group "
+                            "scales)")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--tp", type=int, default=1)
@@ -282,8 +287,8 @@ def main(argv=None) -> int:
         cfg, params = from_hf_llama(args.hf_model, cfg)
     else:
         params = init_params(cfg, jax.random.key(0))
-    if args.int8:
-        params = quantize_params(params)
+    if args.int8 or args.int4:
+        params = quantize_params(params, bits=4 if args.int4 else 8)
 
     n_dev = len(jax.devices())
     mesh = None

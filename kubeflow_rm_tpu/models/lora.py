@@ -55,8 +55,14 @@ def add_lora(params: dict, rank: int, *, key: jax.Array,
             raise KeyError(f"lora target {t!r} not in blocks "
                            f"({sorted(blocks)})")
         w = blocks[t]
-        if isinstance(w, dict):  # int8-quantized base (QLoRA recipe)
-            L, d_in, d_out = w["q"].shape
+        if isinstance(w, dict):  # quantized base (QLoRA recipe)
+            if "q4" in w:        # packed nibbles: (L, G, g/2, out)
+                q4 = w["q4"]
+                L, d_in, d_out = (q4.shape[0],
+                                  q4.shape[-3] * q4.shape[-2] * 2,
+                                  q4.shape[-1])
+            else:
+                L, d_in, d_out = w["q"].shape
             dt = param_dtype or jnp.bfloat16
         else:
             L, d_in, d_out = w.shape

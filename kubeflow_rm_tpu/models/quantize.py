@@ -1,19 +1,31 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8 / int4 quantization for serving.
 
 Single-sequence decode is weights-bound: every token-step streams the
-full parameter set out of HBM while the MXU idles. Halving the bytes
-(bf16 → int8 + per-output-channel fp scales) is therefore nearly a 2×
-token-rate lever, with no activation quantization and no retraining —
-the standard weight-only serving recipe, implemented jax-native.
+full parameter set out of HBM while the MXU idles. Cutting the bytes
+(bf16 → int8, or → packed int4 + per-group scales) is therefore nearly
+a linear token-rate lever, with no activation quantization and no
+retraining — the standard weight-only serving recipe, implemented
+jax-native.
 
-- **Symmetric per-output-channel scales**: ``scale = max|w| / 127``
-  over the contraction axis, stored fp32. The dequant multiply fuses
-  into the matmul epilogue; XLA reads int8 from HBM and converts in
-  VMEM, which is exactly where the bandwidth win comes from.
-- Quantized leaves are ``{"q": int8, "s": fp32}`` dicts; everything the
-  decode path multiplies by (attention/MLP projections, lm_head) is
-  quantized, while norms (tiny) and the embedding (a gather, already
-  one row per token) stay in the original dtype.
+- **int8** (``bits=8``): symmetric per-output-channel scales,
+  ``scale = max|w| / 127`` over the contraction axis, stored fp32.
+  Leaves are ``{"q": int8, "s": fp32}``.
+- **int4** (``bits=4``): symmetric per-group scales (``group_size``
+  rows of the contraction axis share one scale per output channel —
+  finer granularity recovers most of the accuracy the 15-level grid
+  loses), two nibbles packed per int8 byte. Leaves are
+  ``{"q4": int8 packed, "s": fp32}``; a 7B model stores in
+  ~3.6 GB — comfortable on one 16 GiB v5e next to its KV cache.
+  Leaves carry only stacked arrays (no scalar metadata) so they ride
+  ``lax.scan`` over the layer axis like every other weight.
+  Measured tradeoff (BENCH_SWEEP_r04.json): int4 is a **capacity**
+  lever, not a speed lever — the per-matmul nibble unpack costs more
+  than the halved HBM reads (16.3 vs 5.0 ms/token for int8 at 1.2B),
+  so use int8 when the model fits and int4 when it wouldn't.
+- The dequant multiply fuses into the matmul epilogue; XLA reads the
+  narrow weights from HBM and converts in VMEM, which is exactly where
+  the bandwidth win comes from. Norms (tiny) and the embedding (a
+  gather, one row per token) stay in the original dtype.
 - ``models.generate.decode_chunk`` consumes quantized and plain
   pytrees interchangeably (``maybe_dequant``), so ``generate`` and the
   sharded ``make_decode_step`` work unchanged.
@@ -41,27 +53,74 @@ def _quant_leaf(w: jax.Array) -> dict:
     return {"q": q, "s": scale}
 
 
-def quantize_params(params: dict) -> dict:
-    """int8-quantize every matmul weight; norms/embed pass through."""
+def _quant_leaf4(w: jax.Array, group_size: int) -> dict:
+    """Symmetric int4 with per-(group, out-channel) scales, two values
+    packed per byte along the contraction axis."""
+    wf = w.astype(jnp.float32)
+    K = wf.shape[-2]
+    if K % 2:
+        raise ValueError(
+            f"int4 packing needs an even contraction dim, got {K} "
+            "(real transformer dims are even; pad or use int8)")
+    g = min(group_size, K)
+    if K % g or g % 2:
+        g = K  # indivisible or odd group: fall back to one group
+    gshape = wf.shape[:-2] + (K // g, g) + wf.shape[-1:]
+    wg = wf.reshape(gshape)                      # (..., G, g, out)
+    amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / 7.0)
+    q = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int8)
+    hi, lo = q[..., 0::2, :], q[..., 1::2, :]    # (..., G, g/2, out)
+    packed = ((hi << 4) | (lo & 0xF)).astype(jnp.int8)
+    # NOTE: every leaf must carry the leading layer-stack axis so the
+    # pytree rides lax.scan's xs unstacking — no scalar metadata here
+    # (group size is recoverable as 2 * q4.shape[-2])
+    return {"q4": packed, "s": scale}
+
+
+def quantize_params(params: dict, bits: int = 8,
+                    group_size: int = 128) -> dict:
+    """Quantize every matmul weight to ``bits`` (8 or 4);
+    norms/embed pass through. ``group_size`` applies to int4 only."""
+    if bits == 8:
+        quant = _quant_leaf
+    elif bits == 4:
+        def quant(w):
+            return _quant_leaf4(w, group_size)
+    else:
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
     blocks = {
-        k: (_quant_leaf(v) if k in _MATMUL_LEAVES else v)
+        k: (quant(v) if k in _MATMUL_LEAVES else v)
         for k, v in params["blocks"].items()
     }
     out = dict(params, blocks=blocks)
-    out["lm_head"] = _quant_leaf(params["lm_head"])
+    out["lm_head"] = quant(params["lm_head"])
     return out
 
 
 def is_quantized(leaf) -> bool:
-    return isinstance(leaf, dict) and set(leaf) == {"q", "s"}
+    return isinstance(leaf, dict) and set(leaf) in ({"q", "s"},
+                                                    {"q4", "s"})
 
 
 def maybe_dequant(leaf, dtype) -> jax.Array:
-    """Materialize a compute-dtype weight from either representation.
-    Under jit the convert+scale fuses into the consuming matmul."""
-    if is_quantized(leaf):
-        return (leaf["q"].astype(dtype) * leaf["s"].astype(dtype))
-    return leaf.astype(dtype)
+    """Materialize a compute-dtype weight from any representation.
+    Under jit the unpack/convert/scale fuses into the consuming
+    matmul's prologue."""
+    if not isinstance(leaf, dict):
+        return leaf.astype(dtype)
+    if "q4" in leaf:
+        packed = leaf["q4"]                      # (..., G, g/2, out)
+        hi = packed >> 4                         # arithmetic: sign ok
+        lo = (packed << 4).astype(jnp.int8) >> 4
+        q = jnp.stack([hi, lo], axis=-2)         # (..., G, g/2, 2, out)
+        gshape = packed.shape[:-2] + (packed.shape[-2] * 2,) \
+            + packed.shape[-1:]
+        q = q.reshape(gshape)                    # (..., G, g, out)
+        w = q.astype(dtype) * leaf["s"].astype(dtype)
+        K = gshape[-3] * gshape[-2]
+        return w.reshape(gshape[:-3] + (K,) + gshape[-1:])
+    return (leaf["q"].astype(dtype) * leaf["s"].astype(dtype))
 
 
 def quantized_bytes(params: dict) -> int:

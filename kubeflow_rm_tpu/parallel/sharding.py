@@ -67,11 +67,17 @@ def param_pspecs(params) -> dict:
             return P("pp", None, "tp")
         # int8-quantized weights ({"q", "s"} dicts, models.quantize):
         # q shards like the base weight; the per-output-channel scale
-        # keeps the output axis and replicates the collapsed one
-        if key.endswith("/q") or key.endswith("/s"):
+        # keeps the output axis and replicates the collapsed one.
+        # int4 ({"q4", "s"}) splits the contraction axis into
+        # (groups, packed) — the group axis inherits the contraction
+        # sharding, the packed axis replicates; per-group scales have
+        # one extra (singleton) axis and shard the same way.
+        if key.endswith("/q") or key.endswith("/q4") or key.endswith("/s"):
             base = _LLAMA_RULES[key.rsplit("/", 1)[0]]
             if key.endswith("/q"):
                 return base
+            if key.endswith("/q4") or leaf.ndim == len(base) + 1:
+                return P(*base[:-1], None, base[-1])
             return P(*[None if i == len(base) - 2 else ax
                        for i, ax in enumerate(base)])
         if key not in _LLAMA_RULES:
@@ -82,9 +88,55 @@ def param_pspecs(params) -> dict:
 
 
 def param_shardings(params, mesh: Mesh) -> dict:
-    return jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), param_pspecs(params)
-    )
+    """NamedSharding pytree for ``params`` on ``mesh``.
+
+    Quantized leaves adapt instead of erroring: a q4 weight whose
+    group-count axis does not divide the mesh moves its contraction
+    sharding to the packed axis (always a multiple of typical shard
+    counts — e.g. 7B w_down has G=86 groups, indivisible by tp=4, but
+    g/2=64 packed rows shard fine); scales and truly indivisible dims
+    demote to replicated with a warning, because a replicated handful
+    of scale bytes beats a shard-shape error but a silently
+    replicated WEIGHT would defeat int4's capacity purpose. Regular
+    weights stay strict — a non-divisible real weight IS a bug worth
+    raising."""
+    import warnings
+
+    specs = param_pspecs(params)
+
+    def axis_size(ax):
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        return n
+
+    def mk(path, leaf, spec):
+        key = _path_str(path)
+        if key.endswith("/q4"):
+            # trailing dims are (groups, packed, out); leading dims
+            # (layer/expert stacks) pass through untouched
+            st = tuple(spec)
+            lead, (a_in, _, a_out) = st[:-3], st[-3:]
+            G, half = leaf.shape[-3], leaf.shape[-2]
+            if a_in is not None and G % axis_size(a_in):
+                if half % axis_size(a_in) == 0:
+                    spec = P(*lead, None, a_in, a_out)  # packed axis
+                else:
+                    warnings.warn(
+                        f"{key}: neither group ({G}) nor packed "
+                        f"({half}) axis divides the mesh — weight "
+                        "replicated; consider a different group_size")
+                    spec = P(*lead, None, None, a_out)
+        elif key.endswith("/s"):
+            def fit(dim, ax):
+                if ax is None or dim % axis_size(ax) == 0:
+                    return ax
+                return None
+            spec = P(*[fit(d, a) for d, a in zip(leaf.shape, spec)])
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(mk, params, specs)
 
 
 def batch_pspec(sequence_sharded: bool = True) -> P:

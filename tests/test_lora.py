@@ -184,3 +184,35 @@ def test_train_only_without_adapters_fails_loudly(base):
                       optim=OptimConfig(train_only="lora"))
     with pytest.raises(ValueError, match="matched no parameters"):
         init_train_state(cfg, jax.random.key(0), params=params)
+
+
+def test_qlora_int4_base_trains(base, devices8):
+    """QLoRA with the packed-int4 frozen base: the train step runs on
+    a sharded mesh, adapters learn, and the packed base (including its
+    per-group scales) stays byte-identical."""
+    from kubeflow_rm_tpu.models.quantize import quantize_params
+
+    cfg_model, params = base
+    params = jax.tree_util.tree_map(jnp.array, params)
+    qbase = quantize_params(params, bits=4, group_size=16)
+    lparams = add_lora(qbase, rank=4, key=jax.random.key(1))
+    assert lparams["blocks"]["wq_lora_a"].shape[-2] == \
+        params["blocks"]["wq"].shape[-2]  # d_in recovered from packing
+    cfg = TrainConfig(
+        model=cfg_model,
+        optim=OptimConfig(learning_rate=1e-2, warmup_steps=2,
+                          total_steps=100, train_only="lora"))
+    q_before = np.asarray(lparams["blocks"]["wq"]["q4"])
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2), devices8)
+    state = init_train_state(cfg, jax.random.key(0), params=lparams)
+    step = make_train_step(cfg, mesh, state)
+    fixed = next(synthetic_batches(8, 32, cfg_model.vocab_size, seed=0))
+    losses = []
+    for _ in range(8):
+        state, m = step(state, shard_batch(fixed, mesh))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    np.testing.assert_array_equal(
+        np.asarray(state.params["blocks"]["wq"]["q4"]), q_before)
+    assert state.params["blocks"]["wq"]["q4"].dtype == jnp.int8

@@ -15,6 +15,7 @@ from kubeflow_rm_tpu.models.generate import (
     decode_chunk,
     generate,
     generate_fused,
+    generate_speculative_fused,
     init_cache,
     make_decode_step,
     make_generate_step,
@@ -43,6 +44,7 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
 __all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "add_lora",
            "config_from_hf",
            "cache_shardings", "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",
-           "generate", "generate_fused", "init_cache", "init_params",
+           "generate", "generate_fused", "generate_speculative_fused",
+           "init_cache", "init_params",
            "make_decode_step", "make_generate_step",
            "lora_mask", "maybe_dequant", "merge_lora", "quantize_params"]

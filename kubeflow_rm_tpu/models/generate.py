@@ -299,6 +299,145 @@ def generate_fused(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
         total_len=S)
 
 
+def rewind_cache(cache: KVCache, new_offset) -> KVCache:
+    """Logically truncate the cache to ``new_offset`` filled slots.
+
+    Slots at/after ``new_offset`` get position ``_UNFILLED`` — the
+    causal mask then excludes their (stale) K/V from every future
+    query, so physical K/V bytes need no clearing. O(B·S) positions
+    traffic, no weight traffic. The speculative decoder uses this to
+    drop rejected draft tokens."""
+    idx = jnp.arange(cache.positions.shape[1], dtype=jnp.int32)
+    pos = jnp.where(idx[None, :] >= new_offset, _UNFILLED,
+                    cache.positions)
+    return KVCache(k=cache.k, v=cache.v, positions=pos,
+                   offset=jnp.asarray(new_offset, jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "lookup_n",
+                                   "draft_k", "eos_id", "total_len"))
+def _fused_speculative(params, prompt, *, cfg, max_new_tokens,
+                       lookup_n, draft_k, eos_id, total_len):
+    """The whole speculative loop as ONE XLA program (batch 1).
+
+    Decode is weights-bound, so verifying a (draft_k+1)-wide chunk
+    costs roughly the same HBM traffic as a width-1 step — widening is
+    nearly free ON-DEVICE. What ruins host-side speculation on a
+    tunneled chip is the blocking sync every round (lookup + accept
+    decisions on the host); here the n-gram match, draft gather,
+    verification, cache rewind and loop all run under
+    ``lax.while_loop``, so the host dispatches once per generation.
+    Worst case (nothing accepts) each round still commits 1 token at
+    chunk cost ≈ step cost; best case commits draft_k+1.
+    """
+    Tp = prompt.shape[1]
+    W = draft_k + 1
+    S = total_len  # buffer/cache length, incl. chunk overhang room
+    V = cfg.vocab_size
+    target = Tp + max_new_tokens
+
+    buf = jnp.zeros((S,), jnp.int32).at[:Tp].set(prompt[0])
+    cache = init_cache(cfg, 1, S)
+    logits, cache = decode_chunk(params, cfg, cache, prompt)
+    last = logits[0, -1, :]
+
+    def cond(carry):
+        buf, count, cache, last, done, rounds = carry
+        return (count < target) & ~done
+
+    def body(carry):
+        buf, count, cache, last, done, rounds = carry
+        nxt = jnp.argmax(last).astype(jnp.int32)
+        buf = buf.at[count].set(nxt)
+        count = count + 1
+
+        # prompt-lookup on device: most recent earlier occurrence of
+        # the trailing n-gram; its followers become the draft
+        tail = jax.lax.dynamic_slice(buf, (count - lookup_n,),
+                                     (lookup_n,))
+        idx = jnp.arange(S, dtype=jnp.int32)
+        windows = buf[jnp.minimum(idx[:, None]
+                                  + jnp.arange(lookup_n)[None, :],
+                                  S - 1)]
+        hit = (windows == tail[None, :]).all(-1) & (idx < count
+                                                    - lookup_n)
+        has_hit = hit.any()
+        p = jnp.max(jnp.where(hit, idx, -1))  # most recent match
+        start = jnp.where(has_hit, p + lookup_n, 0)
+        draft = jax.lax.dynamic_slice(
+            jnp.pad(buf, (0, W)), (start,), (draft_k,))
+        # no hit → draft vs greedy will disagree, costing nothing
+        # extra: the chunk runs at width W every round regardless
+
+        chunk = jnp.concatenate([nxt[None], draft])[None, :]  # (1, W)
+        logits, cache = decode_chunk(params, cfg, cache, chunk)
+        greedy = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+
+        # accept the longest prefix of drafts matching greedy
+        ok = jnp.cumprod((draft == greedy[:-1]).astype(jnp.int32))
+        budget = jnp.clip(target - count, 0, draft_k)
+        accepted = jnp.minimum(jnp.sum(ok), budget)
+        wpos = count + jnp.arange(draft_k)
+        wmask = jnp.arange(draft_k) < accepted
+        buf = buf.at[jnp.minimum(wpos, S - 1)].set(
+            jnp.where(wmask, draft, buf[jnp.minimum(wpos, S - 1)]))
+        count = count + accepted
+
+        if eos_id is not None:
+            committed = jnp.concatenate([nxt[None], draft])
+            cmask = jnp.arange(W) < (1 + accepted)
+            is_eos = (committed == eos_id) & cmask
+            done = done | is_eos.any()
+
+        # drop the rejected tail: stale K/V is masked via positions
+        cache = rewind_cache(cache, cache.offset - (W - 1 - accepted))
+        last = logits[0, accepted, :]
+        return (buf, count, cache, last, done, rounds + 1)
+
+    buf, count, cache, last, done, rounds = jax.lax.while_loop(
+        cond, body,
+        (buf, jnp.asarray(Tp, jnp.int32), cache, last,
+         jnp.asarray(False), jnp.asarray(1, jnp.int32)))
+    out = buf[:target]
+    if eos_id is not None:
+        # latch: everything after the first generated eos (and any
+        # slot past count, if the loop stopped early) becomes eos
+        pos = jnp.arange(target)
+        is_eos = (out == eos_id) & (pos >= Tp)
+        first = jnp.min(jnp.where(is_eos, pos, target))
+        out = jnp.where((pos > first) | (pos >= count), eos_id, out)
+    return out[None, :], rounds, count
+
+
+def generate_speculative_fused(params: dict, cfg: LlamaConfig,
+                               prompt: jax.Array, *,
+                               max_new_tokens: int, lookup_n: int = 3,
+                               draft_k: int = 8,
+                               eos_id: int | None = None,
+                               stats: dict | None = None) -> jax.Array:
+    """Single-program prompt-lookup speculative decoding (batch 1,
+    greedy). See ``_fused_speculative``; exactness vs ``generate`` is
+    asserted under fp32 in tests (bf16 chunked numerics can resolve
+    near-ties differently, as with any chunked verification)."""
+    B, Tp = prompt.shape
+    if B != 1:
+        raise ValueError("speculative decoding is batch-1 "
+                         f"(got batch {B}); batched requests amortize "
+                         "weights already — use generate_fused")
+    if Tp <= lookup_n:
+        raise ValueError(f"prompt ({Tp}) must be longer than "
+                         f"lookup_n ({lookup_n})")
+    total_len = Tp + max_new_tokens + draft_k + 1
+    out, rounds, count = _fused_speculative(
+        params, prompt, cfg=cfg, max_new_tokens=max_new_tokens,
+        lookup_n=lookup_n, draft_k=draft_k, eos_id=eos_id,
+        total_len=total_len)
+    if stats is not None:
+        stats["model_calls"] = int(rounds)
+        stats["tokens_out"] = int(count) - Tp  # < max_new if eos fired
+    return out
+
+
 def make_generate_step(example_params: dict, cfg: LlamaConfig, mesh, *,
                        max_new_tokens: int, total_len: int,
                        temperature: float = 0.0, top_k: int | None = None,

@@ -94,10 +94,11 @@ def param_shardings(params, mesh: Mesh) -> dict:
     group-count axis does not divide the mesh moves its contraction
     sharding to the packed axis (always a multiple of typical shard
     counts — e.g. 7B w_down has G=86 groups, indivisible by tp=4, but
-    g/2=64 packed rows shard fine); scales and truly indivisible dims
-    demote to replicated with a warning, because a replicated handful
-    of scale bytes beats a shard-shape error but a silently
-    replicated WEIGHT would defeat int4's capacity purpose. Regular
+    g/2=64 packed rows shard fine); a weight with no dividable axis
+    demotes to replicated with a warning (a silently replicated
+    WEIGHT would defeat int4's capacity purpose), while scales demote
+    silently — a replicated handful of scale bytes costs nothing
+    worth warning about. Regular
     weights stay strict — a non-divisible real weight IS a bug worth
     raising."""
     import warnings

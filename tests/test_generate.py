@@ -271,6 +271,76 @@ def test_sharded_fused_generate_matches_single_device(model, devices8):
     assert out.shape == (4, 9)
 
 
+def test_rewind_cache_truncates_logically(model):
+    """rewind_cache masks slots via positions: decode, rewind, then a
+    different continuation must match a fresh decode of that prefix."""
+    from kubeflow_rm_tpu.models.generate import rewind_cache
+
+    cfg, params = model
+    toks = jax.random.randint(jax.random.key(30), (1, 8), 0,
+                              cfg.vocab_size)
+    cache = init_cache(cfg, 1, 12)
+    _, cache = decode_chunk(params, cfg, cache, toks)
+    cache = rewind_cache(cache, 5)          # drop the last 3
+    cont = jax.random.randint(jax.random.key(31), (1, 2), 0,
+                              cfg.vocab_size)
+    got, _ = decode_chunk(params, cfg, cache, cont)
+
+    fresh = init_cache(cfg, 1, 12)
+    _, fresh = decode_chunk(params, cfg, fresh, toks[:, :5])
+    ref, _ = decode_chunk(params, cfg, fresh, cont)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_fused_speculative_matches_greedy(model):
+    """The single-program speculative decoder: exact vs greedy
+    generate on repetitive and random prompts (fp32), with fewer
+    device programs than tokens when the text cooperates."""
+    from kubeflow_rm_tpu.models.generate import (
+        generate_speculative_fused,
+    )
+
+    cfg, params = model
+    rep = jnp.asarray([[7, 11, 13, 17] * 6], jnp.int32)
+    stats = {}
+    out = generate_speculative_fused(params, cfg, rep,
+                                     max_new_tokens=12, stats=stats)
+    ref = generate(params, cfg, rep, max_new_tokens=12)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert 1 <= stats["model_calls"] <= 1 + 12
+
+    rnd = jax.random.randint(jax.random.key(21), (1, 10), 0,
+                             cfg.vocab_size)
+    out = generate_speculative_fused(params, cfg, rnd,
+                                     max_new_tokens=9)
+    ref = generate(params, cfg, rnd, max_new_tokens=9)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    with pytest.raises(ValueError, match="batch-1"):
+        generate_speculative_fused(params, cfg,
+                                   jnp.ones((2, 5), jnp.int32),
+                                   max_new_tokens=2)
+    with pytest.raises(ValueError, match="longer than"):
+        generate_speculative_fused(params, cfg,
+                                   jnp.ones((1, 2), jnp.int32),
+                                   max_new_tokens=2)
+
+
+def test_fused_speculative_eos_latches(model):
+    from kubeflow_rm_tpu.models.generate import (
+        generate_speculative_fused,
+    )
+
+    cfg, params = model
+    prompt = jnp.ones((1, 4), jnp.int32)
+    eos = int(jnp.argmax(forward(params, prompt, cfg)[0, -1]))
+    out = generate_speculative_fused(params, cfg, prompt,
+                                     max_new_tokens=5, eos_id=eos)
+    ref = generate(params, cfg, prompt, max_new_tokens=5, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_sampling_requires_key(model):
     cfg, params = model
     with pytest.raises(ValueError, match="PRNG key"):

@@ -48,6 +48,13 @@ RECONCILE_ERRORS_TOTAL = Counter(
     ["controller"],
     registry=REGISTRY,
 )
+KFAM_REQUESTS_TOTAL = Counter(
+    "kfam_requests_total",
+    "KFAM API requests by action and result "
+    "(ref access-management/kfam/monitoring.go:46-77)",
+    ["action", "result"],
+    registry=REGISTRY,
+)
 TPU_CHIPS_REQUESTED = Gauge(
     "tpu_chips_requested",
     "TPU chips currently requested by scheduled notebook pods",

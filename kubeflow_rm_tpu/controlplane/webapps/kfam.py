@@ -19,6 +19,7 @@ profile/contributor management API. Endpoints:
 
 from __future__ import annotations
 
+import functools
 import re
 
 from werkzeug.exceptions import BadRequest, Forbidden
@@ -26,6 +27,7 @@ from werkzeug.exceptions import BadRequest, Forbidden
 from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
 from kubeflow_rm_tpu.controlplane.api.profile import make_profile
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.metrics import KFAM_REQUESTS_TOTAL
 from kubeflow_rm_tpu.controlplane.webapps.core import (
     USER_HEADER, USER_PREFIX, WebApp, json_body,
 )
@@ -35,6 +37,30 @@ ROLE_ANNOTATION = "role"
 
 ROLE_MAP = {"admin": "kubeflow-admin", "edit": "kubeflow-edit",
             "view": "kubeflow-view"}
+
+
+def _counted(action: str):
+    """Per-action success/error counters, the reference's KFAM
+    prometheus surface (``kfam/monitoring.go:46-77``); scraped from
+    this app's ``/metrics`` like every control-plane process.
+
+    Counts requests that REACH the handler — in-handler authz denials
+    land in the ``error`` bucket, while gateway-level rejections
+    (missing identity header, CSRF) happen before dispatch and are not
+    KFAM actions, the same boundary the reference has behind its
+    mesh's auth filter."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            try:
+                out = fn(*a, **kw)
+            except Exception:
+                KFAM_REQUESTS_TOTAL.labels(action, "error").inc()
+                raise
+            KFAM_REQUESTS_TOTAL.labels(action, "success").inc()
+            return out
+        return wrapper
+    return deco
 
 
 def binding_name(user: str, role: str) -> str:
@@ -47,6 +73,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
     app = WebApp("kfam", api, prefix=prefix, disable_auth=disable_auth, **app_kwargs)
 
     @app.route("/kfam/v1/bindings")
+    @_counted("read_bindings")
     def get_bindings(req):
         ns_filter = req.args.get("namespace")
         user_filter = req.args.get("user")
@@ -88,6 +115,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         return {"bindings": out}
 
     @app.route("/kfam/v1/bindings", methods=("POST",))
+    @_counted("create_binding")
     def post_binding(req):
         b = _parse_binding(json_body(req))
         ns, user, role = b
@@ -117,6 +145,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         return {"message": "Binding created successfully."}
 
     @app.route("/kfam/v1/bindings", methods=("DELETE",))
+    @_counted("delete_binding")
     def delete_binding(req):
         ns, user, role = _parse_binding(json_body(req))
         app.ensure_authorized(req, "delete", "rolebindings", ns)
@@ -127,6 +156,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         return {"message": "Binding deleted successfully."}
 
     @app.route("/kfam/v1/profiles")
+    @_counted("read_profiles")
     def get_profiles(req):
         profiles = api.list("Profile")
         if app.disable_auth:
@@ -144,6 +174,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         return {"profiles": visible}
 
     @app.route("/kfam/v1/profiles", methods=("POST",))
+    @_counted("create_profile")
     def post_profile(req):
         body = json_body(req)
         name = deep_get(body, "metadata", "name")
@@ -164,6 +195,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         return {"message": "Profile created successfully."}
 
     @app.route("/kfam/v1/profiles/<name>", methods=("DELETE",))
+    @_counted("delete_profile")
     def delete_profile(req, name):
         profile = api.get("Profile", name)
         user = app.username(req)
@@ -176,6 +208,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         return {"message": "Profile deleted successfully."}
 
     @app.route("/kfam/v1/role/clusteradmin")
+    @_counted("read_clusteradmin")
     def get_clusteradmin(req):
         user = req.args.get("user") or app.username(req)
         is_admin = api.access_review(user, "*", "*")

@@ -138,6 +138,14 @@ def test_kfam_contributor_binding_grants_access(stack):
     assert not api.access_review("bob@corp.com", "list", "notebooks",
                                  "team")
 
+    # the reference KFAM's prometheus surface (monitoring.go:46-77):
+    # per-action counters, scraped from this app's own /metrics
+    text = client.get("/metrics").get_data(as_text=True)
+    assert 'kfam_requests_total{action="create_binding",' \
+           'result="success"}' in text
+    assert 'kfam_requests_total{action="delete_binding",' \
+           'result="success"}' in text
+
 
 def test_kfam_bindings_listing_is_scoped_to_callers_namespaces(stack):
     """ADVICE r2 (medium): GET /kfam/v1/bindings must not enumerate

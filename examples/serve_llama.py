@@ -63,7 +63,7 @@ class Batcher:
 
     def __init__(self, step_fn, *, max_new_tokens: int, pad_id: int = 0,
                  window_ms: float = 5.0, max_batch: int = 8,
-                 rows_multiple: int = 1):
+                 rows_multiple: int = 1, exact_solo: bool = False):
         # step_fn: (ids (B,T), pad_counts (B,), temperature, top_k)
         #          -> (B, T+new)
         self.step_fn = step_fn
@@ -74,6 +74,13 @@ class Batcher:
         # sharded batches must divide the mesh's data axes: dummy rows
         # (copies of row 0) round B up, and only real rows are returned
         self.rows_multiple = rows_multiple
+        # speculative solo requests need the exact prompt (no pads) —
+        # costs one compile per distinct prompt length instead of per
+        # bucket, the price of the lookup decoder's prefix semantics.
+        # The length set is capped: beyond it, solo requests fall back
+        # to bucketing so cycling lengths can't accumulate compiles.
+        self.exact_solo = exact_solo
+        self._exact_lens: set = set()
         self.q: queue.Queue = queue.Queue()
         self.batches_run = 0
         self._stop = threading.Event()
@@ -131,7 +138,14 @@ class Batcher:
             # would hang every future request forever
             try:
                 lens = [len(b["prompt"]) for b in batch]
-                T = _bucket(max(lens))
+                if (self.exact_solo and len(batch) == 1
+                        and first["temperature"] <= 0
+                        and (lens[0] in self._exact_lens
+                             or len(self._exact_lens) < 16)):
+                    self._exact_lens.add(lens[0])
+                    T = lens[0]
+                else:
+                    T = _bucket(max(lens))
                 B = (-(-len(batch) // self.rows_multiple)
                      * self.rows_multiple)
                 ids = np.full((B, T), self.pad_id, np.int32)
@@ -157,18 +171,25 @@ class Batcher:
 
 def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
              window_ms: float = 5.0, max_batch: int = 8,
-             tokenizer=None):
+             speculative: bool = False, tokenizer=None):
     """werkzeug WSGI app + its Batcher. ``mesh`` switches the backend
-    to the sharded ``make_generate_step`` program."""
+    to the sharded ``make_generate_step`` program; ``speculative``
+    routes solo greedy requests through the single-program
+    prompt-lookup decoder (repetitive text decodes in fewer model
+    passes; see ``generate_speculative_fused``)."""
     import jax
     import numpy as np
     from werkzeug.exceptions import BadRequest, HTTPException
     from werkzeug.routing import Map, Rule
     from werkzeug.wrappers import Request, Response
 
-    from kubeflow_rm_tpu.models import generate_fused, make_generate_step
+    from kubeflow_rm_tpu.models import (
+        generate_fused, generate_speculative_fused, make_generate_step,
+    )
 
     steps = {}  # (total_len, temperature, top_k) -> sharded step
+    LOOKUP_N = 3      # kept in ONE place: guard below + the call
+    app_stats = {"speculative_requests": 0}
 
     def step_fn(ids, pad_counts, temperature, top_k):
         B, T = ids.shape
@@ -176,6 +197,15 @@ def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
         key = jax.random.key(0) if temperature <= 0 else \
             jax.random.key(np.random.randint(0, 2**31 - 1))
         if mesh is None:
+            # pad==0 means the batcher granted exact-solo (its length
+            # set bounds compiles); anything bucketed/padded verifies
+            # on the fused path
+            if (speculative and B == 1 and temperature <= 0
+                    and int(pad_counts[0]) == 0 and T > LOOKUP_N):
+                app_stats["speculative_requests"] += 1
+                return generate_speculative_fused(
+                    params, cfg, ids, max_new_tokens=max_new_tokens,
+                    lookup_n=LOOKUP_N)
             return generate_fused(
                 params, cfg, ids, max_new_tokens=max_new_tokens,
                 key=key, temperature=temperature, top_k=top_k,
@@ -194,7 +224,8 @@ def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
         rows = int(mesh.shape["dp"] * mesh.shape["fsdp"])
     batcher = Batcher(step_fn, max_new_tokens=max_new_tokens,
                       window_ms=window_ms, max_batch=max_batch,
-                      rows_multiple=rows)
+                      rows_multiple=rows,
+                      exact_solo=speculative and mesh is None)
 
     urls = Map([Rule("/generate", endpoint="generate",
                      methods=["POST"]),
@@ -249,6 +280,7 @@ def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
         return resp(environ, start_response)
 
     app.batcher = batcher
+    app.stats = app_stats
     return app
 
 
@@ -265,6 +297,12 @@ def main(argv=None) -> int:
                             "scales)")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--speculative", action="store_true",
+                    help="route solo greedy requests through the "
+                         "prompt-lookup speculative decoder "
+                         "(repetitive text decodes in fewer model "
+                         "passes; one compile per distinct prompt "
+                         "length)")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--fsdp", type=int, default=0,
                     help="0 = all local devices (with --tp 1 ⇒ "
@@ -295,9 +333,14 @@ def main(argv=None) -> int:
     if n_dev > 1 or args.tp > 1:
         fsdp = args.fsdp or max(1, n_dev // args.tp)
         mesh = make_mesh(MeshConfig(fsdp=fsdp, tp=args.tp))
+        if args.speculative:
+            print("warning: --speculative is single-device only "
+                  "(batch-1 lookup decoding); sharded requests take "
+                  "the fused path", flush=True)
 
     app = make_app(cfg, params, max_new_tokens=args.max_new_tokens,
-                   mesh=mesh, max_batch=args.max_batch)
+                   mesh=mesh, max_batch=args.max_batch,
+                   speculative=args.speculative)
 
     if args.selftest:
         from werkzeug.test import Client

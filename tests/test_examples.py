@@ -137,3 +137,40 @@ def test_serve_example_sharded_app(devices8):
         assert toks == np.asarray(ref)[0, :9].tolist()
     finally:
         app.batcher.close()
+
+
+def test_serve_example_speculative_route():
+    """--speculative: a solo greedy request takes the prompt-lookup
+    decoder (exact, unpadded prompt) and returns the same tokens the
+    plain fused path would."""
+    import jax
+    from werkzeug.test import Client
+
+    from examples.serve_llama import make_app
+    from kubeflow_rm_tpu.models import (
+        LlamaConfig, generate, init_params,
+    )
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    app = make_app(cfg, params, max_new_tokens=5, window_ms=1,
+                   speculative=True)
+    try:
+        prompt = [4, 8, 15, 16, 23]
+        r = Client(app).post("/generate", json={"prompt": prompt})
+        assert r.status_code == 200, r.get_data()
+        toks = r.get_json()["tokens"]
+        ref = generate(params, cfg, jax.numpy.asarray([prompt]),
+                       max_new_tokens=5)
+        assert toks == np.asarray(ref)[0].tolist()
+        # the route observable: this request went through the
+        # speculative decoder, not merely the fused path
+        assert app.stats["speculative_requests"] == 1
+        # sampling must NOT take it
+        r = Client(app).post("/generate",
+                             json={"prompt": prompt,
+                                   "temperature": 0.9})
+        assert r.status_code == 200
+        assert app.stats["speculative_requests"] == 1
+    finally:
+        app.batcher.close()

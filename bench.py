@@ -72,6 +72,7 @@ def main(argv=None) -> None:
         #   mb2 dots     accum64           60.36   <- default
         #   mb2 dots     accum128          60.45   (asymptote; 2x step time)
         #   mb1 dots     accum8  seq4096   56.28
+        #   mb1 dots     accum64 seq4096   57.27
         #   mb2 attn     accum8  seq4096   54.77
         #   mb2 dots     accum8  seq4096   OOM (17.7G)
         #   mb4 (any remat)                OOM
@@ -216,12 +217,16 @@ FRONTIER = [
     {"mb": 2, "remat": "dots", "accum": 64, "mfu": 60.36},
     {"mb": 2, "remat": "dots", "accum": 128, "mfu": 60.45},
     {"mb": 1, "remat": "dots", "accum": 8, "seq": 4096, "mfu": 56.28},
+    {"mb": 1, "remat": "dots", "accum": 32, "seq": 4096, "mfu": 57.14},
+    {"mb": 1, "remat": "dots", "accum": 64, "seq": 4096, "mfu": 57.27},
     {"mb": 2, "remat": "attn", "accum": 8, "seq": 4096, "mfu": 54.77},
     {"mb": 2, "remat": "dots", "accum": 8, "seq": 4096, "mfu": "OOM"},
     # long context, single chip: full remat is what fits; the 32k wall
     # is where the sp attention backends (ring/ulysses) take over
     {"mb": 1, "remat": "full", "accum": 4, "seq": 8192, "mfu": 48.97},
+    {"mb": 1, "remat": "full", "accum": 16, "seq": 8192, "mfu": 49.71},
     {"mb": 1, "remat": "full", "accum": 4, "seq": 16384, "mfu": 45.11},
+    {"mb": 1, "remat": "full", "accum": 8, "seq": 16384, "mfu": 45.34},
     {"mb": 1, "remat": "full", "accum": 2, "seq": 32768, "mfu": "OOM"},
 ]
 

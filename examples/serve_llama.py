@@ -240,8 +240,12 @@ def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
                                 content_type="application/json")
                 return resp(environ, start_response)
             body = req.get_json(force=True)
+            if not isinstance(body, dict):
+                raise BadRequest("body must be a JSON object")
             if tokenizer is not None and "text" in body:
-                prompt = tokenizer.encode(body["text"])
+                if not isinstance(body["text"], str):
+                    raise BadRequest("text must be a string")
+                prompt = list(tokenizer.encode(body["text"]))
             else:
                 prompt = body.get("prompt")
             if (not isinstance(prompt, list) or not prompt
@@ -297,6 +301,9 @@ def main(argv=None) -> int:
                             "scales)")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--hf-tokenizer", default=None,
+                    help="HF tokenizer id/path: lets clients pass "
+                         '{"text": ...} and get text back')
     ap.add_argument("--speculative", action="store_true",
                     help="route solo greedy requests through the "
                          "prompt-lookup speculative decoder "
@@ -338,9 +345,19 @@ def main(argv=None) -> int:
                   "(batch-1 lookup decoding); sharded requests take "
                   "the fused path", flush=True)
 
+    tokenizer = None
+    if args.hf_tokenizer:
+        from transformers import AutoTokenizer
+        tokenizer = AutoTokenizer.from_pretrained(args.hf_tokenizer)
+        if len(tokenizer) > cfg.vocab_size:
+            print(f"warning: tokenizer vocab ({len(tokenizer)}) exceeds "
+                  f"model vocab_size ({cfg.vocab_size}) — text requests "
+                  "producing out-of-range ids will be rejected",
+                  flush=True)
+
     app = make_app(cfg, params, max_new_tokens=args.max_new_tokens,
                    mesh=mesh, max_batch=args.max_batch,
-                   speculative=args.speculative)
+                   speculative=args.speculative, tokenizer=tokenizer)
 
     if args.selftest:
         from werkzeug.test import Client

@@ -174,3 +174,62 @@ def test_serve_example_speculative_route():
         assert app.stats["speculative_requests"] == 1
     finally:
         app.batcher.close()
+
+
+def test_serve_example_text_roundtrip_with_tokenizer():
+    """A server-side tokenizer lets clients speak text: encode on the
+    way in, decode on the way out."""
+    import jax
+    from werkzeug.test import Client
+
+    from examples.serve_llama import make_app
+    from kubeflow_rm_tpu.models import LlamaConfig, init_params
+
+    class StubTok:
+        def encode(self, text):
+            return [ord(c) % 250 + 1 for c in text]
+
+        def decode(self, ids):
+            return " ".join(str(i) for i in ids)
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    app = make_app(cfg, params, max_new_tokens=3, window_ms=1,
+                   tokenizer=StubTok())
+    try:
+        r = Client(app).post("/generate", json={"text": "hello"})
+        assert r.status_code == 200, r.get_data()
+        body = r.get_json()
+        assert len(body["tokens"]) == 5 + 3
+        assert body["text"] == " ".join(str(i) for i in body["tokens"])
+    finally:
+        app.batcher.close()
+
+
+def test_serve_example_text_validation():
+    """Malformed text bodies get 400s, not 500s."""
+    import jax
+    from werkzeug.test import Client
+
+    from examples.serve_llama import make_app
+    from kubeflow_rm_tpu.models import LlamaConfig, init_params
+
+    class StubTok:
+        def encode(self, text):
+            return [1, 2]
+
+        def decode(self, ids):
+            return ""
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(cfg, jax.random.key(0))
+    app = make_app(cfg, params, max_new_tokens=2, window_ms=1,
+                   tokenizer=StubTok())
+    try:
+        c = Client(app)
+        assert c.post("/generate", json={"text": 123}).status_code == 400
+        assert c.post("/generate", json={"text": ["a"]}).status_code == 400
+        assert c.post("/generate", json="text").status_code == 400
+        assert c.post("/generate", json={"text": "ok"}).status_code == 200
+    finally:
+        app.batcher.close()

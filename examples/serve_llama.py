@@ -276,7 +276,11 @@ def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
             tokens = batcher.submit(prompt, temp, top_k)
             out = {"tokens": tokens}
             if tokenizer is not None:
-                out["text"] = tokenizer.decode(tokens)
+                try:  # HF tokenizers: strip <s>/</s> markers
+                    out["text"] = tokenizer.decode(
+                        tokens, skip_special_tokens=True)
+                except TypeError:  # minimal tokenizers (tests)
+                    out["text"] = tokenizer.decode(tokens)
             resp = Response(json.dumps(out),
                             content_type="application/json")
         except HTTPException as e:

@@ -148,6 +148,10 @@ class Batcher:
                     T = _bucket(max(lens))
                 B = (-(-len(batch) // self.rows_multiple)
                      * self.rows_multiple)
+                # batch size is a compile shape too: round rows up to
+                # a power of two so varying coalesce counts reuse
+                # log2(max_batch) programs instead of one per count
+                B = _bucket(B, lo=1)
                 ids = np.full((B, T), self.pad_id, np.int32)
                 for i, b in enumerate(batch):
                     ids[i, T - lens[i]:] = b["prompt"]   # left-pad

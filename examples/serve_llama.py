@@ -20,9 +20,11 @@ TPU-shaped choices:
   tokenization happens client-side (or pass ``--hf-tokenizer`` to
   decode text server-side when the files are available).
 
-API: ``POST /generate {"prompt": [ids...], "max_new_tokens"?: n,
-"temperature"?: t, "top_k"?: k}`` → ``{"tokens": [ids...]}``;
-``GET /healthz``.
+API: ``POST /generate {"prompt": [ids...], "temperature"?: t,
+"top_k"?: k}`` → ``{"tokens": [ids...]}``; ``GET /healthz``.
+Generation length is server-fixed (``--max-new-tokens``); sampling
+params are compile-shape keys, so temperature snaps to a 0.05 grid
+and top_k snaps to a small allowed set — both documented below.
 
 Tiny smoke (CPU, what tests/test_examples.py runs):
     python examples/serve_llama.py --preset tiny --selftest
@@ -41,6 +43,11 @@ import threading
 import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+# top_k values the API serves; requests snap to the nearest member
+# (top_k is a static compile key — see make_app)
+TOP_K_CHOICES = (1, 5, 10, 20, 50, 100)
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -90,11 +97,25 @@ class Batcher:
     def submit(self, prompt: list[int], temperature: float = 0.0,
                top_k: int | None = None) -> list[int]:
         """Blocking: returns prompt + continuation token ids."""
+        if self._stop.is_set():
+            raise RuntimeError("batcher is closed")
         done = threading.Event()
         box: dict = {"prompt": prompt, "temperature": temperature,
                      "top_k": top_k, "done": done}
         self.q.put(box)
-        done.wait()
+        # wake periodically: if close() killed the drain thread while
+        # this request sat queued, nobody will ever set done — an
+        # in-flight batch still completes (the thread finishes its
+        # current batch before exiting), so only stop+dead-thread is
+        # a guaranteed-orphan condition
+        while not done.wait(timeout=1.0):
+            if self._stop.is_set() and not self._thread.is_alive():
+                # the drain thread may have finished this very box
+                # between the wait timing out and the checks above
+                if done.is_set():
+                    break
+                raise RuntimeError("batcher closed with request "
+                                   "pending")
         if "error" in box:
             raise RuntimeError(box["error"])
         return box["result"]
@@ -103,6 +124,16 @@ class Batcher:
         self._stop.set()
         self.q.put(None)
         self._thread.join(timeout=5)
+        # fail anything still queued (the drain thread can exit on the
+        # sentinel while real requests remain behind it)
+        while True:
+            try:
+                box = self.q.get_nowait()
+            except queue.Empty:
+                break
+            if box is not None:
+                box["error"] = "batcher closed"
+                box["done"].set()
 
     def _run(self):
         import numpy as np
@@ -146,12 +177,13 @@ class Batcher:
                     T = lens[0]
                 else:
                     T = _bucket(max(lens))
-                B = (-(-len(batch) // self.rows_multiple)
-                     * self.rows_multiple)
-                # batch size is a compile shape too: round rows up to
-                # a power of two so varying coalesce counts reuse
-                # log2(max_batch) programs instead of one per count
-                B = _bucket(B, lo=1)
+                # batch size is a compile shape too: bucket the batch
+                # in UNITS of rows_multiple (power-of-two unit counts)
+                # so varying coalesce counts reuse log2(max_batch)
+                # programs AND B stays divisible by the mesh's data
+                # axes even when dp*fsdp is not a power of two
+                units = -(-len(batch) // self.rows_multiple)
+                B = _bucket(units, lo=1) * self.rows_multiple
                 ids = np.full((B, T), self.pad_id, np.int32)
                 for i, b in enumerate(batch):
                     ids[i, T - lens[i]:] = b["prompt"]   # left-pad
@@ -277,6 +309,14 @@ def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
                     or not 1 <= top_k <= cfg.vocab_size):
                 raise BadRequest("top_k must be an int in "
                                  f"[1, {cfg.vocab_size}]")
+            # top_k is a compile key too (static in the fused program
+            # and part of the sharded steps cache key): snap it to a
+            # small allowed set so a client cycling values can't
+            # accumulate one compiled program per distinct k
+            if top_k is not None:
+                choices = [c for c in TOP_K_CHOICES
+                           if c <= cfg.vocab_size] or [1]
+                top_k = min(choices, key=lambda c: abs(c - top_k))
             tokens = batcher.submit(prompt, temp, top_k)
             out = {"tokens": tokens}
             if tokenizer is not None:

@@ -33,13 +33,36 @@ def main(argv=None) -> None:
     ap.add_argument("--accum", type=int, default=None,
                     help="gradient-accumulation microbatch count")
     ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--preset", default=None,
+                    choices=["tiny", "bench_1b", "bench_2b", "bench_3b",
+                             "llama2_7b", "llama2_13b", "llama3_8b"],
+                    help="LlamaConfig preset to bench (default: "
+                         "bench_1b on TPU, tiny on CPU) — the "
+                         "mfu-vs-scale ladder runs bench_1b/bench_2b/"
+                         "bench_3b/llama2_7b")
+    ap.add_argument("--optim", choices=["adamw", "adafactor"],
+                    default="adamw",
+                    help="adafactor = factored second moment, no "
+                         "first moment (~0 optimizer bytes/param): "
+                         "what fits a ~3B FULL fine-tune on one v5e")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="train rank-r adapters on a frozen base "
+                         "instead of full fine-tuning (the 7B QLoRA "
+                         "recipe)")
+    ap.add_argument("--base-quant", choices=["int8", "int4"],
+                    default=None,
+                    help="with --lora-rank: quantize the frozen base "
+                         "(built directly in quantized form on-chip)")
     ap.add_argument("--decode", action="store_true",
                     help="benchmark decode (loop vs fused scan) instead")
     ap.add_argument("--quant", choices=["int8", "int4"], default=None,
                     help="with --decode: weight-only quantize first")
     args = ap.parse_args(argv)
+    if args.base_quant and not args.lora_rank:
+        ap.error("--base-quant requires --lora-rank (a quantized base "
+                 "cannot take full-fine-tune gradients)")
     if args.decode:
-        return decode_bench(args.batch, args.quant)
+        return decode_bench(args.batch, args.quant, args.preset)
 
     import jax
     import jax.numpy as jnp
@@ -82,21 +105,44 @@ def main(argv=None) -> None:
         # update is off the critical path (recompute is the next cost).
         accum = 64 if args.accum is None else args.accum
         batch = (2 * accum) if args.batch is None else args.batch
-        model = LlamaConfig.bench_1b(
+        preset = getattr(LlamaConfig, args.preset or "bench_1b")
+        model = preset(
             param_dtype=jnp.bfloat16,
             remat_policy=args.remat or "dots",
             **({"max_seq_len": args.seq} if args.seq else {}))
         steps, warmup = args.steps, 2
     else:
-        model = LlamaConfig.tiny()
+        preset = getattr(LlamaConfig, args.preset or "tiny")
+        model = preset()
         batch, steps, warmup, accum = 8, 6, 2, 1
+        if args.batch:
+            batch = args.batch
+        if args.accum:
+            accum = args.accum
     seq_len = model.max_seq_len if on_tpu else 128
 
-    cfg = TrainConfig(model=model)
+    from kubeflow_rm_tpu.training.optim import OptimConfig
+    optim = OptimConfig(factored=args.optim == "adafactor",
+                        train_only="lora" if args.lora_rank else None)
+    cfg = TrainConfig(model=model, optim=optim)
     mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=1, tp=1),
                      devices=devices[:1])
 
-    state = init_train_state(cfg, jax.random.key(0))
+    if args.lora_rank:
+        from kubeflow_rm_tpu.models import add_lora, init_params
+        if args.base_quant:
+            from kubeflow_rm_tpu.models.quantize import (
+                init_params_quantized,
+            )
+            params = init_params_quantized(
+                model, jax.random.key(0),
+                bits=4 if args.base_quant == "int4" else 8)
+        else:
+            params = init_params(model, jax.random.key(0))
+        params = add_lora(params, args.lora_rank, key=jax.random.key(1))
+        state = init_train_state(cfg, jax.random.key(0), params=params)
+    else:
+        state = init_train_state(cfg, jax.random.key(0))
     step = make_train_step(cfg, mesh, state, grad_accum=accum)
 
     rng = np.random.default_rng(0)
@@ -120,7 +166,8 @@ def main(argv=None) -> None:
 
     step_time = dt / steps
     tokens_per_sec = batch * seq_len / step_time
-    flops_tok = train_flops_per_token(model, seq_len)
+    flops_tok = train_flops_per_token(model, seq_len,
+                                      frozen_base=bool(args.lora_rank))
     peak = device_peak_flops(devices[0])
     achieved = tokens_per_sec * flops_tok
 
@@ -138,20 +185,34 @@ def main(argv=None) -> None:
         "step_time_ms": round(step_time * 1e3, 2),
         "achieved_tflops": round(achieved / 1e12, 2),
         "device": getattr(devices[0], "device_kind", platform),
-        "model": "llama-bench1b" if on_tpu else "llama-tiny(cpu-fallback)",
+        "model": (f"llama-{args.preset or 'bench_1b'}" if on_tpu
+                  else f"llama-{args.preset or 'tiny'}(cpu-fallback)"),
         "batch": batch,
         "grad_accum": accum,
         "seq_len": seq_len,
         "remat_policy": model.remat_policy,
+        "optim": args.optim,
         "final_loss": round(final_loss, 4),
     }
-    if on_tpu and args.accum is None and args.remat is None:
+    if args.lora_rank:
+        out["lora_rank"] = args.lora_rank
+        out["base_quant"] = args.base_quant or "bf16"
+        # honest accounting: frozen-base training executes ~4
+        # FLOPs/param/token, and that is what "value" charges; the 6N
+        # full-fine-tune convention (what r4's 15.8% used) is carried
+        # alongside for cross-round comparability
+        six_n = tokens_per_sec * train_flops_per_token(model, seq_len)
+        out["mfu_6n_convention"] = (round(100.0 * six_n / peak, 2)
+                                    if peak else 0.0)
+    if (on_tpu and args.accum is None and args.remat is None
+            and args.preset in (None, "bench_1b")
+            and not args.lora_rank and args.optim == "adamw"):
         # default run: carry the audited frontier (BENCH_SWEEP_r04.json)
         out["frontier"] = FRONTIER
     print(json.dumps(out))
 
 
-def decode_bench(batch=None, quant=None) -> None:
+def decode_bench(batch=None, quant=None, preset=None) -> None:
     """Loop-vs-fused decode throughput (``--decode``): the per-token
     jit dispatch of ``generate`` against the single-program
     ``generate_fused`` scan, same bf16 bench-1b weights and cache.
@@ -167,15 +228,20 @@ def decode_bench(batch=None, quant=None) -> None:
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
     if on_tpu:
-        cfg = LlamaConfig.bench_1b(param_dtype=jnp.bfloat16)
+        make = getattr(LlamaConfig, preset or "bench_1b")
+        cfg = make(param_dtype=jnp.bfloat16)
         B, Tp, new = batch or 4, 128, 384
     else:
-        cfg = LlamaConfig.tiny()
+        cfg = getattr(LlamaConfig, preset or "tiny")()
         B, Tp, new = batch or 2, 8, 16
-    params = init_params(cfg, jax.random.key(0))
     if quant:
-        from kubeflow_rm_tpu.models import quantize_params
-        params = quantize_params(params, bits=4 if quant == "int4" else 8)
+        # build DIRECTLY in quantized form: a 7B never has a resident
+        # full-precision copy on a 16 GiB chip
+        from kubeflow_rm_tpu.models.quantize import init_params_quantized
+        params = init_params_quantized(
+            cfg, jax.random.key(0), bits=4 if quant == "int4" else 8)
+    else:
+        params = init_params(cfg, jax.random.key(0))
     prompt = jax.random.randint(jax.random.key(1), (B, Tp), 0,
                                 cfg.vocab_size)
 
@@ -197,6 +263,7 @@ def decode_bench(batch=None, quant=None) -> None:
         "unit": "tok/s",
         "vs_baseline": round(t_loop / t_fused, 2),
         "batch": B, "prefill": Tp, "new_tokens": new,
+        "model": f"llama-{preset or ('bench_1b' if on_tpu else 'tiny')}",
         "loop_ms_per_token": round(1e3 * t_loop / new, 2),
         "fused_ms_per_token": round(1e3 * t_fused / new, 2),
         "speedup": round(t_loop / t_fused, 2),

@@ -110,12 +110,22 @@ def main(argv=None) -> int:
     state = None  # built below once params are final
     if args.lora_rank:
         from kubeflow_rm_tpu.models import add_lora, init_params
-        if params is None:
-            params = init_params(model_cfg, jax.random.key(0))
-        if args.int8_base or args.int4_base:
-            from kubeflow_rm_tpu.models import quantize_params
-            params = quantize_params(
-                params, bits=4 if args.int4_base else 8)
+        bits = 4 if args.int4_base else 8
+        if params is None and (args.int8_base or args.int4_base):
+            # no checkpoint: build the base DIRECTLY in quantized form,
+            # leaf by leaf — a 7B's full-precision copy never fits next
+            # to its quantized one on a 16 GiB chip
+            from kubeflow_rm_tpu.models.quantize import (
+                init_params_quantized,
+            )
+            params = init_params_quantized(model_cfg, jax.random.key(0),
+                                           bits=bits)
+        else:
+            if params is None:
+                params = init_params(model_cfg, jax.random.key(0))
+            if args.int8_base or args.int4_base:
+                from kubeflow_rm_tpu.models import quantize_params
+                params = quantize_params(params, bits=bits)
         params = add_lora(params, args.lora_rank, key=jax.random.key(1))
 
     # 3. the data

@@ -107,6 +107,27 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def bench_2b(**overrides) -> "LlamaConfig":
+        """~2.1B params: the mid rung of the single-chip MFU-vs-scale
+        ladder (full fine-tune on one v5e with the factored optimizer —
+        see bench.py --preset bench_2b --optim adafactor)."""
+        return replace(
+            LlamaConfig(dim=2560, n_layers=24, n_heads=20, n_kv_heads=20,
+                        hidden_dim=6912, max_seq_len=2048),
+            **overrides,
+        )
+
+    @staticmethod
+    def bench_3b(**overrides) -> "LlamaConfig":
+        """~3.1B params: the largest full-fine-tune that fits a 16 GiB
+        v5e (params + transient grads ≈ 4 bytes/param with adafactor)."""
+        return replace(
+            LlamaConfig(dim=3072, n_layers=26, n_heads=24, n_kv_heads=24,
+                        hidden_dim=8192, max_seq_len=2048),
+            **overrides,
+        )
+
+    @staticmethod
     def tiny(**overrides) -> "LlamaConfig":
         """Test-sized config: runs in milliseconds on a CPU mesh."""
         return replace(
@@ -175,18 +196,22 @@ def init_params(cfg: LlamaConfig, key: jax.Array,
         shapes, is_leaf=lambda x: isinstance(x, tuple)
     )
     keys = jax.random.split(key, len(flat))
-    out_scale = 0.02 / (2.0 * cfg.n_layers) ** 0.5
-
-    def init_one(path, shape, k):
-        name = path[-1].key
-        if "norm" in name:
-            return jnp.ones(shape, cfg.param_dtype)
-        if name in ("wo", "w_down", "moe_down"):  # residual-writing projections
-            return (jax.random.normal(k, shape) * out_scale).astype(cfg.param_dtype)
-        return (jax.random.normal(k, shape) * 0.02).astype(cfg.param_dtype)
-
-    leaves = [init_one(p, s, k) for (p, s), k in zip(flat, keys)]
+    leaves = [init_leaf(cfg, p[-1].key, s, k)
+              for (p, s), k in zip(flat, keys)]
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def init_leaf(cfg: LlamaConfig, name: str, shape, k: jax.Array):
+    """Init rule for ONE named parameter leaf — the single source of
+    truth shared by ``init_params`` and the leaf-at-a-time
+    ``quantize.init_params_quantized`` (which must stay bit-identical
+    to materialize-then-quantize)."""
+    out_scale = 0.02 / (2.0 * cfg.n_layers) ** 0.5
+    if "norm" in name:
+        return jnp.ones(shape, cfg.param_dtype)
+    if name in ("wo", "w_down", "moe_down"):  # residual-writing projections
+        return (jax.random.normal(k, shape) * out_scale).astype(cfg.param_dtype)
+    return (jax.random.normal(k, shape) * 0.02).astype(cfg.param_dtype)
 
 
 def _attention_half(cfg: LlamaConfig, x, layer, cos, sin, positions,

@@ -78,17 +78,21 @@ def _quant_leaf4(w: jax.Array, group_size: int) -> dict:
     return {"q4": packed, "s": scale}
 
 
+def _quant_fn(bits: int, group_size: int):
+    """The bits→leaf-quantizer dispatch shared by ``quantize_params``
+    and ``init_params_quantized``."""
+    if bits == 8:
+        return _quant_leaf
+    if bits == 4:
+        return lambda w: _quant_leaf4(w, group_size)
+    raise ValueError(f"bits must be 8 or 4, got {bits}")
+
+
 def quantize_params(params: dict, bits: int = 8,
                     group_size: int = 128) -> dict:
     """Quantize every matmul weight to ``bits`` (8 or 4);
     norms/embed pass through. ``group_size`` applies to int4 only."""
-    if bits == 8:
-        quant = _quant_leaf
-    elif bits == 4:
-        def quant(w):
-            return _quant_leaf4(w, group_size)
-    else:
-        raise ValueError(f"bits must be 8 or 4, got {bits}")
+    quant = _quant_fn(bits, group_size)
     blocks = {
         k: (quant(v) if k in _MATMUL_LEAVES else v)
         for k, v in params["blocks"].items()
@@ -96,6 +100,52 @@ def quantize_params(params: dict, bits: int = 8,
     out = dict(params, blocks=blocks)
     out["lm_head"] = quant(params["lm_head"])
     return out
+
+
+def init_params_quantized(cfg, key: jax.Array, bits: int = 8,
+                          group_size: int = 128) -> dict:
+    """Random-init a model DIRECTLY into quantized form, one leaf at a
+    time, so the full-precision copy never exists in HBM.
+
+    ``quantize_params(init_params(cfg, key))`` needs the whole fp32/bf16
+    tree resident before the first leaf quantizes — for a 7B that is
+    ~13-27 GiB and OOMs a 16 GiB v5e. Here each matmul leaf runs
+    init→quantize inside ONE jitted call whose full-precision tensor is
+    a transient (largest: the stacked w_up, ~2.9 GiB bf16 at 7B), so
+    peak HBM is the quantized model plus one leaf. Bit-identical to the
+    two-step path (asserted by tests/test_quantize.py) because it
+    splits keys and applies the same init/quant math in the same order.
+
+    This is the synthetic-weights entry the 7B serving/QLoRA benches
+    use; ``from_hf_llama`` + ``quantize_params`` on a big-RAM host is
+    the real-checkpoint equivalent.
+    """
+    from kubeflow_rm_tpu.models.llama import init_leaf, param_spec_shapes
+
+    quant = _quant_fn(bits, group_size)
+    # dispatch shapes like models.init_params does (MixtralConfig
+    # reuses llama's init rules over its own shape tree)
+    from kubeflow_rm_tpu.models.mixtral import MixtralConfig
+    from kubeflow_rm_tpu.models.mixtral import (
+        param_spec_shapes as moe_shapes,
+    )
+    shapes = (moe_shapes(cfg) if isinstance(cfg, MixtralConfig)
+              else param_spec_shapes(cfg))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        shapes, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    keys = jax.random.split(key, len(flat))
+
+    leaves = []
+    for (path, shape), k in zip(flat, keys):
+        name = path[-1].key
+        if name in _MATMUL_LEAVES or name == "lm_head":
+            fn = jax.jit(lambda kk, n=name, s=shape:
+                         quant(init_leaf(cfg, n, s, kk)))
+            leaves.append(jax.block_until_ready(fn(k)))
+        else:
+            leaves.append(init_leaf(cfg, name, shape, k))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def is_quantized(leaf) -> bool:

@@ -152,7 +152,8 @@ def fit(
                 tokens = steps_done * dev_batch["tokens"].size
                 tps = tokens / dt if dt > 0 else 0.0
                 flops = tps * train_flops_per_token(
-                    cfg.model, dev_batch["tokens"].shape[-1])
+                    cfg.model, dev_batch["tokens"].shape[-1],
+                    frozen_base=cfg.optim.train_only is not None)
                 rec = LoopMetrics(
                     step=now,
                     loss=float(m["loss"]),

@@ -4,6 +4,15 @@ AdamW with a no-decay mask on norms/embeddings, warmup+cosine schedule,
 global-norm clipping. ``mu_dtype`` defaults to bf16: on a 16 GiB v5e
 chip the first-moment buffer is the difference between fitting a ~1B
 model and not; the second moment stays fp32 for stability.
+
+``factored=True`` swaps adam's per-parameter moments for adafactor's
+factored second moment (row/col RMS vectors, ~O(in+out) per matrix
+instead of O(in*out)) with no first moment — the optimizer that was
+built for exactly this hardware constraint (TPU HBM; Shazeer & Stern
+2018). Optimizer state drops from ~6 bytes/param to ~0, which is what
+lets a ~3B model FULL-fine-tune on one 16 GiB v5e
+(params 2B + transient grads 2B ≈ 4 bytes/param); see bench.py
+--optim adafactor and BENCH_SWEEP_r05.json's mfu-vs-scale table.
 """
 
 from dataclasses import dataclass
@@ -22,6 +31,12 @@ class OptimConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     mu_dtype: str = "bfloat16"
+    # factored second moment (adafactor), no first moment: near-zero
+    # optimizer state for the multi-billion-single-chip memory shape
+    factored: bool = False
+    # dims below this stay unfactored (optax default; tests lower it —
+    # every real model dim here is >= 2048)
+    factored_min_dim: int = 128
     # "lora": train only adapter leaves (models.lora); the train step
     # then neither computes gradients nor stores moments for the frozen
     # base — the memory shape that fits 7B fine-tuning on one chip
@@ -46,11 +61,25 @@ def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
         decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
         end_value=cfg.learning_rate * 0.1,
     )
+    if cfg.factored:
+        # the full adafactor update rule (optax.adafactor's chain):
+        # factored RMS normalization, block-RMS update clipping, and
+        # the relative (parameter-scale) step size — without the last
+        # two the RMS-normalized update is O(1) per element and walks
+        # small-init weights straight out of their basin
+        scaler = optax.chain(
+            optax.scale_by_factored_rms(
+                decay_rate=cfg.b2,
+                min_dim_size_to_factor=cfg.factored_min_dim),
+            optax.clip_by_block_rms(1.0),
+            optax.scale_by_param_block_rms(),
+        )
+    else:
+        scaler = optax.scale_by_adam(
+            b1=cfg.b1, b2=cfg.b2, mu_dtype=jnp.dtype(cfg.mu_dtype))
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
-        optax.scale_by_adam(
-            b1=cfg.b1, b2=cfg.b2, mu_dtype=jnp.dtype(cfg.mu_dtype)
-        ),
+        scaler,
         optax.add_decayed_weights(cfg.weight_decay, mask=_decay_mask),
         optax.scale_by_schedule(lambda step: -schedule(step)),
     )

@@ -103,11 +103,23 @@ def state_shardings(cfg: TrainConfig, state: TrainState, mesh: Mesh) -> TrainSta
     pshard = param_shardings(state.params, mesh)
     replicated = NamedSharding(mesh, P())
     param_treedef = jax.tree_util.tree_structure(state.params)
+    param_leaves = jax.tree_util.tree_leaves(state.params)
 
     def map_node(node):
         try:
             if jax.tree_util.tree_structure(node) == param_treedef:
-                return pshard
+                # params-shaped state (adam moments) inherits the param
+                # shardings leaf-for-leaf — but only where shapes match:
+                # adafactor's factored stats share the STRUCTURE while
+                # holding row/col vectors, which must stay replicated
+                node_leaves = jax.tree_util.tree_leaves(node)
+                shard_leaves = [
+                    s if getattr(n, "shape", None) == p.shape else replicated
+                    for n, p, s in zip(node_leaves, param_leaves,
+                                       jax.tree_util.tree_leaves(pshard))
+                ]
+                return jax.tree_util.tree_unflatten(param_treedef,
+                                                    shard_leaves)
         except Exception:
             pass
         if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple

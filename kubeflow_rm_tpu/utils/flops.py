@@ -47,9 +47,16 @@ def matmul_param_count(cfg: LlamaConfig) -> int:
 
 
 def train_flops_per_token(cfg: LlamaConfig, seq_len: int,
-                          causal: bool = True) -> float:
-    """Model FLOPs per trained token for one fwd+bwd step."""
-    mat = 6.0 * matmul_param_count(cfg)
+                          causal: bool = True,
+                          frozen_base: bool = False) -> float:
+    """Model FLOPs per trained token for one fwd+bwd step.
+
+    ``frozen_base=True`` (LoRA/QLoRA): the base weights take no
+    weight-gradient matmuls, so each matmul param costs 4 FLOPs/token
+    (fwd 2 + input-grad 2) instead of 6 — adapter weight-grads are
+    O(rank/dim) and ignored. Attention (parameter-free) backward is
+    unchanged. Without this, LoRA MFU reads ~1.5× too high."""
+    mat = (4.0 if frozen_base else 6.0) * matmul_param_count(cfg)
     # score (QK^T) + weighted value (PV): 2·2·H·hd·T fwd, ×3 with bwd
     attn = 12.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len
     if causal:

@@ -152,3 +152,54 @@ def test_int4_odd_group_dim_falls_back(model):
         _quant_leaf4(params["blocks"]["wq"][:, :61, :], 32)
     leaf = _quant_leaf4(w, 15)              # odd group -> one group
     assert maybe_dequant(leaf, jnp.float32).shape == w.shape
+
+
+def test_init_params_quantized_matches_two_step(model):
+    """Leaf-by-leaf quantized init is bit-identical to materialize-
+    then-quantize — same key splits, same math (the 7B on-chip path)."""
+    from kubeflow_rm_tpu.models.quantize import init_params_quantized
+
+    cfg, _ = model
+    for bits in (8, 4):
+        direct = init_params_quantized(cfg, jax.random.key(7), bits=bits)
+        twostep = quantize_params(init_params(cfg, jax.random.key(7)),
+                                  bits=bits)
+        d_flat = jax.tree_util.tree_flatten_with_path(direct)[0]
+        t_flat = jax.tree_util.tree_flatten_with_path(twostep)[0]
+        assert len(d_flat) == len(t_flat)
+        for (dp_, dv), (tp_, tv) in zip(d_flat, t_flat):
+            assert dp_ == tp_
+            np.testing.assert_allclose(np.asarray(dv), np.asarray(tv),
+                                       rtol=0, atol=1e-6,
+                                       err_msg=str(dp_))
+
+
+def test_init_params_quantized_generates(model):
+    """A directly-quantized model decodes (the serving entry point)."""
+    from kubeflow_rm_tpu.models.generate import generate_fused
+    from kubeflow_rm_tpu.models.quantize import init_params_quantized
+
+    cfg, _ = model
+    params = init_params_quantized(cfg, jax.random.key(3), bits=4)
+    out = generate_fused(params, cfg,
+                         jnp.asarray([[1, 2, 3]]), max_new_tokens=4)
+    assert out.shape == (1, 7)
+
+
+def test_init_params_quantized_moe_dispatch():
+    """MixtralConfig builds a router-carrying quantized tree identical
+    to materialize-then-quantize (same dispatch as models.init_params)."""
+    from kubeflow_rm_tpu.models import MixtralConfig, init_params
+    from kubeflow_rm_tpu.models.quantize import init_params_quantized
+
+    cfg = MixtralConfig.tiny_moe()
+    direct = init_params_quantized(cfg, jax.random.key(5), bits=8)
+    assert "router" in direct["blocks"]
+    twostep = quantize_params(init_params(cfg, jax.random.key(5)),
+                              bits=8)
+    for (dp_, dv), (tp_, tv) in zip(
+            jax.tree_util.tree_flatten_with_path(direct)[0],
+            jax.tree_util.tree_flatten_with_path(twostep)[0]):
+        assert dp_ == tp_
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(tv),
+                                   atol=1e-6, err_msg=str(dp_))

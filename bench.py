@@ -34,8 +34,9 @@ def main(argv=None) -> None:
                     help="gradient-accumulation microbatch count")
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--preset", default=None,
-                    choices=["tiny", "bench_1b", "bench_2b", "bench_3b",
-                             "llama2_7b", "llama2_13b", "llama3_8b"],
+                    choices=["tiny", "bench_1b", "bench_2b", "bench_2_7b",
+                             "bench_3b", "llama2_7b", "llama2_13b",
+                             "llama3_8b"],
                     help="LlamaConfig preset to bench (default: "
                          "bench_1b on TPU, tiny on CPU) — the "
                          "mfu-vs-scale ladder runs bench_1b/bench_2b/"

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Round-5 single-chip sweep driver.
+
+Runs each bench config in a FRESH process (leftover HBM state poisons
+later configs — see .claude/skills/verify) and appends one JSON line
+per config to ``BENCH_SWEEP_r05_raw.jsonl``. Two campaigns:
+
+- ``scale``: the MFU-vs-scale ladder (full fine-tune with the factored
+  optimizer at 1.2B/2.1B/3.1B) — does the 40% north-star line hold as
+  params grow? (VERDICT r4 "what's weak" #1)
+- ``qlora``: the 7B QLoRA recipe tuned the way the 1.2B bench was
+  (microbatch/accum/remat), int8 and int4 bases.
+
+Usage: python benchmarks/sweep_r05.py [scale|qlora|decode7b|all]
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+SCALE = [
+    # preset, args — each row one fresh process
+    ("bench_1b", ["--optim", "adafactor", "--accum", "64", "--steps", "4"]),
+    ("bench_2b", ["--optim", "adafactor", "--accum", "32", "--steps", "4"]),
+    ("bench_2b", ["--optim", "adafactor", "--accum", "64", "--steps", "4"]),
+    ("bench_2b", ["--optim", "adafactor", "--accum", "64", "--steps", "4",
+                  "--batch", "64"]),           # mb1
+    ("bench_3b", ["--optim", "adafactor", "--accum", "32", "--steps", "3",
+                  "--batch", "32"]),           # mb1 dots
+    ("bench_3b", ["--optim", "adafactor", "--accum", "32", "--steps", "3",
+                  "--batch", "32", "--remat", "full"]),
+    ("bench_3b", ["--optim", "adafactor", "--accum", "32", "--steps", "3",
+                  "--batch", "64", "--remat", "full"]),  # mb2 full
+    ("bench_3b", ["--optim", "adafactor", "--accum", "64", "--steps", "3",
+                  "--batch", "64", "--remat", "full"]),  # mb1 deeper accum
+]
+
+QLORA = [
+    ("llama2_7b", ["--lora-rank", "16", "--base-quant", "int8",
+                   "--seq", "2048", "--steps", "3", "--remat", "full",
+                   "--batch", "1", "--accum", "1"]),     # r4 repro point
+    ("llama2_7b", ["--lora-rank", "16", "--base-quant", "int8",
+                   "--seq", "2048", "--steps", "3", "--remat", "full",
+                   "--batch", "8", "--accum", "4"]),     # mb2
+    ("llama2_7b", ["--lora-rank", "16", "--base-quant", "int8",
+                   "--seq", "2048", "--steps", "3", "--remat", "full",
+                   "--batch", "16", "--accum", "4"]),    # mb4
+    ("llama2_7b", ["--lora-rank", "16", "--base-quant", "int8",
+                   "--seq", "2048", "--steps", "3", "--remat", "full",
+                   "--batch", "32", "--accum", "8"]),    # mb4 deeper
+    ("llama2_7b", ["--lora-rank", "16", "--base-quant", "int8",
+                   "--seq", "2048", "--steps", "3", "--remat", "attn",
+                   "--batch", "8", "--accum", "4"]),     # mb2 attn-save
+    ("llama2_7b", ["--lora-rank", "16", "--base-quant", "int4",
+                   "--seq", "2048", "--steps", "3", "--remat", "full",
+                   "--batch", "8", "--accum", "4"]),     # int4 base mb2
+    ("llama2_7b", ["--lora-rank", "16", "--base-quant", "int4",
+                   "--seq", "2048", "--steps", "3", "--remat", "full",
+                   "--batch", "16", "--accum", "4"]),    # int4 mb4
+    ("llama2_7b", ["--lora-rank", "16", "--base-quant", "int8",
+                   "--seq", "4096", "--steps", "3", "--remat", "full",
+                   "--batch", "4", "--accum", "4"]),     # long-seq point
+]
+
+SCALE2 = [
+    # follow-up after the first ladder pass: bench_2b mb2 OOMs under
+    # "dots" (stacked per-layer saves + 62% fragmentation) -> try the
+    # cheaper-save policies; bench_3b (3.1B) is past the single-chip
+    # wall at ANY remat (state alone ~12.6G) -> bench_2_7b is the
+    # largest-that-fits rung
+    ("bench_2b", ["--optim", "adafactor", "--accum", "32", "--steps", "4",
+                  "--remat", "full"]),               # mb2 full
+    ("bench_2b", ["--optim", "adafactor", "--accum", "32", "--steps", "4",
+                  "--remat", "attn+mlp"]),           # mb2 named-save
+    ("bench_2_7b", ["--optim", "adafactor", "--accum", "32", "--steps", "3",
+                    "--batch", "32"]),               # mb1 dots
+    ("bench_2_7b", ["--optim", "adafactor", "--accum", "32", "--steps", "3",
+                    "--batch", "32", "--remat", "full"]),
+    ("bench_2_7b", ["--optim", "adafactor", "--accum", "32", "--steps", "3",
+                    "--batch", "64", "--remat", "full"]),  # mb2 full
+    ("bench_2_7b", ["--optim", "adafactor", "--accum", "64", "--steps", "3",
+                    "--batch", "64", "--remat", "full"]),
+]
+
+DECODE7B = [
+    ("llama2_7b", ["--decode", "--quant", "int4"]),
+    ("llama2_7b", ["--decode", "--quant", "int4", "--batch", "8"]),
+    ("llama2_7b", ["--decode", "--quant", "int8"]),
+    ("llama2_7b", ["--decode", "--quant", "int8", "--batch", "8"]),
+    ("llama2_7b", ["--decode", "--quant", "int8", "--batch", "16"]),
+]
+
+
+def run(campaign: str, rows, out_path: str):
+    for preset, extra in rows:
+        cmd = [sys.executable, "bench.py", "--preset", preset] + extra
+        t0 = time.time()
+        try:
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=1800)
+            line = p.stdout.strip().splitlines()[-1] if p.stdout.strip() \
+                else ""
+            rec = json.loads(line) if line.startswith("{") else {
+                "error": (p.stderr or "no output")[-800:]}
+        except subprocess.TimeoutExpired:
+            rec = {"error": "timeout 1800s"}
+        except Exception as e:  # noqa: BLE001 - log and continue sweeping
+            rec = {"error": repr(e)}
+        rec["campaign"] = campaign
+        rec["cmd"] = " ".join(cmd[1:])
+        rec["wall_s"] = round(time.time() - t0, 1)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    out = "BENCH_SWEEP_r05_raw.jsonl"
+    if which in ("scale", "all"):
+        run("scale", SCALE, out)
+    if which in ("qlora", "all"):
+        run("qlora", QLORA, out)
+    if which in ("scale2", "all2"):
+        run("scale", SCALE2, out)
+    if which in ("decode7b", "all"):
+        run("decode7b", DECODE7B, out)
+
+
+if __name__ == "__main__":
+    main()

@@ -146,4 +146,13 @@ def make_cluster_manager(api, *, enable_culling: bool = True,
 WATCHED_KINDS = (
     "Notebook", "Profile", "Tensorboard", "PVCViewer",
     "StatefulSet", "Deployment", "Service", "Pod", "Event",
+    # owned satellite kinds: controller-runtime's Owns() starts an
+    # informer per owned type, which is what lets the cached client
+    # serve reconcile_child's try_get-before-create from memory —
+    # without these, every satellite read is a live GET and the
+    # 20-way spawn storm goes apiserver-bound
+    "Secret", "ServiceAccount", "ConfigMap", "RoleBinding",
+    "NetworkPolicy", "VirtualService", "Route", "ResourceQuota",
+    "Namespace", "Node", "AuthorizationPolicy",
+    "PersistentVolumeClaim", "PodDefault",
 )

@@ -18,10 +18,14 @@ from __future__ import annotations
 import copy
 import datetime
 import fnmatch
+import functools
+import json
+import threading
 from typing import Callable
 
 from kubeflow_rm_tpu.controlplane.api.meta import (
     deep_get,
+    fast_deepcopy,
     labels_of,
     matches_selector,
     name_of,
@@ -65,9 +69,32 @@ def _utcnow() -> datetime.datetime:
     return datetime.datetime.now(datetime.timezone.utc)
 
 
+# store objects are always JSON-shaped (they arrive through
+# create/update, which copy), so the JSON-round-trip copy applies
+_fastcopy = fast_deepcopy
+
+
+def _synchronized(fn):
+    """Serialize a verb on the store lock. The real apiserver runs
+    writes through etcd transactions; here a reentrant lock gives the
+    same guarantee the Conflict check needs (read-compare-write of
+    resourceVersion is atomic) once callers are multithreaded — the
+    REST facade's ThreadingHTTPServer and the parallel Manager both
+    are. Reentrant because verbs nest (patch→update,
+    delete→_finalize_delete→garbage-collect→delete). Watchers fire
+    under the lock, in rv order; they must stay non-blocking (ours
+    enqueue and return)."""
+    @functools.wraps(fn)
+    def wrapper(self, *a, **k):
+        with self._lock:
+            return fn(self, *a, **k)
+    return wrapper
+
+
 class APIServer:
     def __init__(self, clock: Callable[[], datetime.datetime] = _utcnow):
         self.clock = clock
+        self._lock = threading.RLock()
         self._store: dict[tuple[str, str | None, str], dict] = {}
         self._rv = 0
         # admission plugins: fn(op, obj, old) -> obj | None (op: CREATE/UPDATE)
@@ -105,8 +132,14 @@ class APIServer:
         return str(self._rv)
 
     def _emit(self, event: str, obj: dict, old: dict | None = None) -> None:
+        # ONE defensive copy shared by all watchers — the watcher
+        # contract is read-only + non-blocking (Manager._on_event
+        # enqueues, RestServer._on_event serializes); per-watcher
+        # deepcopies measurably dominated the 20-way spawn event storm
+        obj_c = _fastcopy(obj)
+        old_c = _fastcopy(old) if old else None
         for w in list(self._watchers):
-            w(event, copy.deepcopy(obj), copy.deepcopy(old) if old else None)
+            w(event, obj_c, old_c)
 
     def _run_admission(self, op: str, obj: dict, old: dict | None) -> dict:
         for pattern, fn in self._admission:
@@ -116,6 +149,7 @@ class APIServer:
                     obj = result
         return obj
 
+    @_synchronized
     def ensure_namespace(self, namespace: str) -> dict:
         try:
             return self.get("Namespace", namespace)
@@ -124,8 +158,9 @@ class APIServer:
                                 "metadata": {"name": namespace}})
 
     # ---- verbs -------------------------------------------------------
+    @_synchronized
     def create(self, obj: dict) -> dict:
-        obj = copy.deepcopy(obj)
+        obj = _fastcopy(obj)
         kind = obj["kind"]
         name, ns = name_of(obj), namespace_of(obj)
         if kind in CLUSTER_SCOPED_KINDS:
@@ -153,14 +188,16 @@ class APIServer:
         meta["creationTimestamp"] = self.clock().isoformat()
         self._store[key] = obj
         self._emit("ADDED", obj)
-        return copy.deepcopy(obj)
+        return _fastcopy(obj)
 
+    @_synchronized
     def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
         key = self._key(kind, name, namespace)
         if key not in self._store:
             raise NotFound(f"{kind} {namespace}/{name} not found")
-        return copy.deepcopy(self._store[key])
+        return _fastcopy(self._store[key])
 
+    @_synchronized
     def try_get(self, kind: str, name: str,
                 namespace: str | None = None) -> dict | None:
         try:
@@ -168,6 +205,7 @@ class APIServer:
         except NotFound:
             return None
 
+    @_synchronized
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict | None = None) -> list[dict]:
         out = []
@@ -179,12 +217,26 @@ class APIServer:
             if label_selector and not matches_selector(
                     labels_of(obj), label_selector):
                 continue
-            out.append(copy.deepcopy(obj))
+            out.append(_fastcopy(obj))
         out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
         return out
 
+    @_synchronized
+    def scan(self, kind: str, namespace: str | None = None) -> list[dict]:
+        """READ-ONLY ``list``: returns live store references WITHOUT
+        copying. For in-process consumers on hot paths (the fake
+        kubelet's scheduler sums chip usage over every Pod per
+        reconcile — copy-per-object turned that O(pods) read into the
+        top CPU entry of the 20-way spawn profile). Callers MUST NOT
+        mutate the returned objects; mutate a ``get()`` copy and write
+        it back through ``update``. Remote adapters don't have this
+        method — use ``getattr(api, "scan", api.list)``."""
+        return [o for (k, ns, _), o in self._store.items()
+                if k == kind and (namespace is None or ns == namespace)]
+
+    @_synchronized
     def update(self, obj: dict) -> dict:
-        obj = copy.deepcopy(obj)
+        obj = _fastcopy(obj)
         kind, name, ns = obj["kind"], name_of(obj), namespace_of(obj)
         if kind in CLUSTER_SCOPED_KINDS:
             ns = None
@@ -203,7 +255,7 @@ class APIServer:
                 self._validators[kind](obj)
             except Exception as e:
                 raise Invalid(f"{kind} {ns}/{name}: {e}") from e
-        obj = self._run_admission("UPDATE", obj, copy.deepcopy(old))
+        obj = self._run_admission("UPDATE", obj, _fastcopy(old))
         # immutable fields
         obj["metadata"]["uid"] = old["metadata"]["uid"]
         obj["metadata"]["creationTimestamp"] = old["metadata"]["creationTimestamp"]
@@ -217,8 +269,9 @@ class APIServer:
                 not obj["metadata"].get("finalizers"):
             return self._finalize_delete(key)
         self._emit("MODIFIED", obj, old)
-        return copy.deepcopy(obj)
+        return _fastcopy(obj)
 
+    @_synchronized
     def patch(self, kind: str, name: str, patch: dict,
               namespace: str | None = None) -> dict:
         current = self.get(kind, name, namespace)
@@ -227,12 +280,14 @@ class APIServer:
             current["metadata"]["resourceVersion"]
         return self.update(merged)
 
+    @_synchronized
     def update_status(self, obj: dict) -> dict:
         """Status-subresource write: only ``status`` is applied."""
         current = self.get(obj["kind"], name_of(obj), namespace_of(obj))
-        current["status"] = copy.deepcopy(obj.get("status", {}))
+        current["status"] = _fastcopy(obj.get("status", {}))
         return self.update(current)
 
+    @_synchronized
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
         key = self._key(kind, name, namespace)
         if key not in self._store:
@@ -246,10 +301,12 @@ class APIServer:
             return
         self._finalize_delete(key)
 
+    @_synchronized
     def append_pod_log(self, namespace: str, pod_name: str,
                        line: str) -> None:
         self._pod_logs.setdefault((namespace, pod_name), []).append(line)
 
+    @_synchronized
     def pod_logs(self, namespace: str, pod_name: str,
                  tail_lines: int | None = None) -> str:
         """Stored container stdout for a pod (kube ``pods/.../log``).
@@ -277,7 +334,7 @@ class APIServer:
                     self.delete(kind, name, kns)
                 except NotFound:
                     pass
-        return copy.deepcopy(obj)
+        return _fastcopy(obj)
 
     def _garbage_collect(self, owner: dict) -> None:
         """Cascade-delete dependents referencing the deleted owner's uid."""
@@ -296,6 +353,7 @@ class APIServer:
                 pass
 
     # ---- events ------------------------------------------------------
+    @_synchronized
     def record_event(self, involved: dict, etype: str, reason: str,
                      message: str) -> dict:
         """Create a v1 Event for ``involved`` (controller event recorder)."""
@@ -323,6 +381,7 @@ class APIServer:
         }
         return self.create(ev)
 
+    @_synchronized
     def events_for(self, involved: dict) -> list[dict]:
         ns = namespace_of(involved)
         return [
@@ -334,6 +393,7 @@ class APIServer:
     # ---- SubjectAccessReview (kube-apiserver authorization) ----------
     READ_VERBS = frozenset({"get", "list", "watch"})
 
+    @_synchronized
     def access_review(self, user: str | None, verb: str, resource: str,
                       namespace: str | None = None) -> bool:
         """Evaluate RBAC the way a SubjectAccessReview does: the web
@@ -355,7 +415,10 @@ class APIServer:
         """
         if user is None:
             return False
-        for crb in self.list("ClusterRoleBinding"):
+        # scan(): read-only store references (we hold the verb lock) —
+        # SARs arrive per web-app request, and copy-per-binding made
+        # authorization a measurable slice of spawn-storm CPU
+        for crb in self.scan("ClusterRoleBinding"):
             if not self._binding_has_subject(crb, user, None):
                 continue
             role = deep_get(crb, "roleRef", "name") or ""
@@ -365,7 +428,7 @@ class APIServer:
                 return True
         if namespace is None:
             return False
-        for rb in self.list("RoleBinding", namespace):
+        for rb in self.scan("RoleBinding", namespace):
             if not self._binding_has_subject(rb, user, namespace):
                 continue
             role = deep_get(rb, "roleRef", "name") or ""
@@ -418,11 +481,14 @@ class APIServer:
 
     # ---- ResourceQuota enforcement (kube-apiserver built-in) ---------
     def _enforce_quota(self, pod: dict) -> None:
+        # scan(): read-only references — list() would deep-copy every
+        # pod in the namespace per admission, turning an N-pod spawn
+        # burst into O(N²) copies
         ns = namespace_of(pod)
-        quotas = self.list("ResourceQuota", ns)
+        quotas = self.scan("ResourceQuota", ns)
         if not quotas:
             return
-        pods = [p for p in self.list("Pod", ns)
+        pods = [p for p in self.scan("Pod", ns)
                 if not p["metadata"].get("deletionTimestamp")]
 
         def pod_resource(p: dict, resource: str, kind: str) -> float:

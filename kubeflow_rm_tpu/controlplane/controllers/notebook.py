@@ -27,6 +27,7 @@ import copy
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
 from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
 from kubeflow_rm_tpu.controlplane.api.meta import (
+    fast_deepcopy,
     annotations_of,
     deep_get,
     name_of,
@@ -111,7 +112,7 @@ class NotebookController(Controller):
         stopped = nb_api.STOP_ANNOTATION in annotations_of(notebook)
         replicas = 0 if stopped else hosts
 
-        pod_spec = copy.deepcopy(
+        pod_spec = fast_deepcopy(
             deep_get(notebook, "spec", "template", "spec", default={}))
         containers = pod_spec.get("containers") or []
         if containers:
@@ -315,7 +316,7 @@ def _copy_virtualservice_fields(desired: dict, found: dict) -> bool:
             found["metadata"][field] = dict(want)
             changed = True
     if found.get("spec") != desired.get("spec"):
-        found["spec"] = copy.deepcopy(desired["spec"])
+        found["spec"] = fast_deepcopy(desired["spec"])
         changed = True
     return changed
 

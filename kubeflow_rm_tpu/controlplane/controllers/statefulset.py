@@ -20,6 +20,7 @@ from __future__ import annotations
 import copy
 
 from kubeflow_rm_tpu.controlplane.api.meta import (
+    fast_deepcopy,
     deep_get,
     labels_of,
     matches_selector,
@@ -94,8 +95,9 @@ class StatefulSetController(Controller):
         replicas = deep_get(sts, "spec", "replicas", default=1)
         ns = req.namespace
 
+        scan = getattr(api, "scan", api.list)  # read-only fast path
         existing = {
-            name_of(p): p for p in api.list("Pod", ns)
+            name_of(p): p for p in scan("Pod", ns)
             if any(r.get("uid") == sts["metadata"]["uid"]
                    for r in p["metadata"].get("ownerReferences", []))
         }
@@ -147,7 +149,8 @@ class StatefulSetController(Controller):
         self._mirror_status(api, sts)
         from kubeflow_rm_tpu.controlplane import metrics
         metrics.TPU_CHIPS_REQUESTED.set(sum(
-            _pod_tpu_request(p) for p in api.list("Pod")
+            _pod_tpu_request(p)
+            for p in getattr(api, "scan", api.list)("Pod")
             if deep_get(p, "spec", "nodeName")))
         return requeue
 
@@ -161,11 +164,12 @@ class StatefulSetController(Controller):
         if not getattr(api, "quota_enforcement", True):
             return True
         ns = namespace_of(sts)
-        quotas = api.list("ResourceQuota", ns)
+        scan = getattr(api, "scan", api.list)
+        quotas = scan("ResourceQuota", ns)
         if not quotas:
             return True
         template_pod = self._render_pod(sts, 0)
-        live = [p for p in api.list("Pod", ns)
+        live = [p for p in scan("Pod", ns)
                 if not p["metadata"].get("deletionTimestamp")]
         for quota in quotas:
             hard = deep_get(quota, "spec", "hard", default={}) or {}
@@ -196,7 +200,7 @@ class StatefulSetController(Controller):
     # -- pod rendering -------------------------------------------------
     def _render_pod(self, sts: dict, ordinal: int) -> dict:
         name = f"{name_of(sts)}-{ordinal}"
-        tmpl = copy.deepcopy(deep_get(sts, "spec", "template", default={}))
+        tmpl = fast_deepcopy(deep_get(sts, "spec", "template", default={}))
         labels = dict(tmpl.get("metadata", {}).get("labels") or {})
         labels[POD_NAME_LABEL] = name
         pod = {
@@ -209,7 +213,7 @@ class StatefulSetController(Controller):
                 "annotations": dict(
                     tmpl.get("metadata", {}).get("annotations") or {}),
             },
-            "spec": copy.deepcopy(tmpl.get("spec") or {}),
+            "spec": fast_deepcopy(tmpl.get("spec") or {}),
         }
         pod["spec"]["hostname"] = name
         svc = deep_get(sts, "spec", "serviceName")
@@ -218,16 +222,29 @@ class StatefulSetController(Controller):
         return pod
 
     # -- scheduling + status (the fake kubelet) ------------------------
+    #: scheduling is a read-compute-write over SHARED node capacity:
+    #: two parallel reconciles (Manager workers > 1) that both read
+    #: `used` before either binds a pod would over-commit a node's
+    #: chips — the kube-scheduler equivalent is a single serialized
+    #: assume/bind cycle, so serialize ours the same way
+    _bind_lock = __import__("threading").Lock()
+
     def _schedule_and_run(self, api: APIServer, sts: dict) -> None:
+        with self._bind_lock:
+            self._schedule_and_run_locked(api, sts)
+
+    def _schedule_and_run_locked(self, api: APIServer, sts: dict) -> None:
         ns = namespace_of(sts)
-        nodes = api.list("Node")
+        scan = getattr(api, "scan", api.list)
+        nodes = scan("Node")
+        # this STS's pods ARE mutated below (nodeName/status) -> copies
         pods = [p for p in api.list("Pod", ns)
                 if any(r.get("uid") == sts["metadata"]["uid"]
                        for r in p["metadata"].get("ownerReferences", []))]
 
         # chips already committed per node
         used: dict[str, float] = {}
-        for p in api.list("Pod"):
+        for p in scan("Pod"):
             node = deep_get(p, "spec", "nodeName")
             if node:
                 used[node] = used.get(node, 0.0) + _pod_tpu_request(p)
@@ -324,7 +341,7 @@ class StatefulSetController(Controller):
 
     def _mirror_status(self, api: APIServer, sts: dict) -> None:
         ns = namespace_of(sts)
-        pods = [p for p in api.list("Pod", ns)
+        pods = [p for p in getattr(api, "scan", api.list)("Pod", ns)
                 if any(r.get("uid") == sts["metadata"]["uid"]
                        for r in p["metadata"].get("ownerReferences", []))]
         ready = sum(

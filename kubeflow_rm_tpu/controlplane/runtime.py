@@ -168,43 +168,124 @@ class Manager:
         )
 
     def run_forever(self, stop=None, poll_interval_s: float = 1.0,
-                    on_error: Callable | None = None) -> None:
+                    on_error: Callable | None = None,
+                    workers: int = 1) -> None:
         """In-cluster serving loop: drain the queues whenever watch
         events (fanned into ``_on_event`` by the kube adapter's watch
         threads) or timed requeues produce work; sleep ``poll_interval_s``
         between drains. ``stop`` is a ``threading.Event``; reconcile
-        errors that exhaust retries go to ``on_error`` (default: log)."""
+        errors that exhaust retries go to ``on_error`` (default: log).
+
+        ``workers`` > 1 reconciles DIFFERENT objects concurrently on a
+        thread pool while keeping the one-reconcile-per-key invariant
+        (controller-runtime's MaxConcurrentReconciles). This is the
+        20-way provisioning fix: a reconcile against a real apiserver
+        is a chain of HTTP round-trips, and one serial drain thread
+        turns N simultaneous spawns into an N× latency queue — the
+        reference exposes --qps/--burst for exactly this path
+        (notebook-controller/main.go:71-85)."""
         import logging
         import threading
         stop = stop or threading.Event()
         logger = logging.getLogger("kubeflow_rm_tpu.manager")
-        while not stop.is_set():
-            self._wake.clear()
-            try:
-                self.run_until_idle()
-            except RuntimeError as e:
-                logger.error("manager drain failed: %s", e)
-            for cname, req, err in self.errors:
+
+        def report_errors():
+            with self._queue_lock:
+                errs, self.errors = self.errors, []
+            for cname, req, err in errs:
                 if on_error:
                     on_error(cname, req, err)
                 else:
                     logger.error("%s %s gave up after retries: %s",
                                  cname, req, err)
-            self.errors.clear()
-            # woken immediately by enqueue; the timeout only bounds how
-            # late a timed requeue (or stop) can fire
-            self._wake.wait(poll_interval_s)
+
+        if workers <= 1:
+            while not stop.is_set():
+                self._wake.clear()
+                try:
+                    self.run_until_idle()
+                except RuntimeError as e:
+                    logger.error("manager drain failed: %s", e)
+                report_errors()
+                # woken immediately by enqueue; the timeout only bounds
+                # how late a timed requeue (or stop) can fire
+                self._wake.wait(poll_interval_s)
+            return
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        inflight: set[tuple[str, Request]] = set()  # guarded by _queue_lock
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="reconcile") as pool:
+            while not stop.is_set():
+                self._wake.clear()
+                # brief dwell so an event burst (pod ADDED + MODIFIED +
+                # STS MODIFIED from one spawn) coalesces into ONE
+                # reconcile per key instead of one per event — the
+                # work-queue rate limiter's job in controller-runtime
+                if stop.wait(0.01):
+                    break
+                submitted = []
+                with self._queue_lock:
+                    for cname, req in self._due_timed():
+                        self._queues[cname].add(req)
+                    for c in self.controllers:
+                        for req in sorted(self._queues[c.name]):
+                            key = (c.name, req)
+                            if key in inflight:
+                                # re-enqueued while reconciling: stays
+                                # queued; the worker's finish wakes us
+                                continue
+                            self._queues[c.name].discard(req)
+                            inflight.add(key)
+                            submitted.append((c, req))
+                for c, req in submitted:
+                    pool.submit(self._reconcile_one, c, req, inflight)
+                report_errors()
+                self._wake.wait(poll_interval_s)
+
+    def _reconcile_one(self, c: Controller, req: Request,
+                       inflight: set) -> None:
+        """One worker-pool reconcile with the serial loop's
+        retry/requeue semantics."""
+        import logging
+        try:
+            try:
+                requeue_after = c.reconcile(self.api, req)
+                with self._queue_lock:
+                    self._retries.pop((c.name, req), None)
+                if requeue_after is not None:
+                    due = self.api.clock() + datetime.timedelta(
+                        seconds=requeue_after)
+                    with self._queue_lock:
+                        self._timed.append((due, c.name, req))
+            except Conflict as e:
+                self._retry(c, req, e)
+            except NotFound:
+                pass  # object vanished; level-triggered
+            except Exception as e:
+                logging.getLogger("kubeflow_rm_tpu.manager").debug(
+                    "%s %s: %s", c.name, req, e)
+                self._retry(c, req, e)
+        finally:
+            with self._queue_lock:
+                inflight.discard((c.name, req))
+            # the key may have been re-enqueued mid-flight: wake the
+            # dispatcher so it gets picked up at HTTP latency
+            self._wake.set()
 
     def _retry(self, c: Controller, req: Request, e: Exception) -> None:
         from kubeflow_rm_tpu.controlplane import metrics
         metrics.RECONCILE_ERRORS_TOTAL.labels(controller=c.name).inc()
         k = (c.name, req)
-        n = self._retries.get(k, 0) + 1
-        self._retries[k] = n
-        if n <= self.MAX_RETRIES:
+        with self._queue_lock:
+            n = self._retries.get(k, 0) + 1
+            self._retries[k] = n
+            give_up = n > self.MAX_RETRIES
+            if give_up:
+                self.errors.append((c.name, req, e))
+        if not give_up:
             self.enqueue(c, req)
-        else:
-            self.errors.append((c.name, req, e))
 
 
 def rwo_mounting_node(api: APIServer, namespace: str,

@@ -61,7 +61,8 @@ class WebApp:
     def __init__(self, name: str, api: APIServer, *, prefix: str = "",
                  disable_auth: bool = False, secure_cookies: bool = True,
                  user_header: str = USER_HEADER,
-                 user_prefix: str = USER_PREFIX):
+                 user_prefix: str = USER_PREFIX,
+                 authz_cache_ttl: float | None = None):
         self.name = name
         self.api = api
         self.prefix = prefix.rstrip("/")
@@ -69,6 +70,18 @@ class WebApp:
         self.secure_cookies = secure_cookies
         self.user_header = user_header
         self.user_prefix = user_prefix
+        # SubjectAccessReview decision cache: kube-apiserver's webhook
+        # authorizer caches decisions (allow 5 min / deny 30 s by
+        # default); a short symmetric TTL here keeps a polling SPA from
+        # turning every status refresh into a live SAR round-trip.
+        # Env override KFRM_AUTHZ_CACHE_TTL; 0 disables (tests that
+        # flip RBAC mid-flight want instant effect).
+        if authz_cache_ttl is None:
+            import os
+            authz_cache_ttl = float(
+                os.environ.get("KFRM_AUTHZ_CACHE_TTL", "2.0"))
+        self.authz_cache_ttl = authz_cache_ttl
+        self._authz_cache: dict[tuple, tuple[bool, float]] = {}
         self._map = Map()
         self._handlers: dict[str, Callable] = {}
         self._no_auth: set[str] = set()
@@ -108,11 +121,31 @@ class WebApp:
         user = self.username(req)
         if user is None:
             raise Unauthorized("No user credentials were found!")
-        if not self.api.access_review(user, verb, resource, namespace):
+        if not self._access_review_cached(user, verb, resource,
+                                          namespace):
             msg = f"User '{user}' is not authorized to {verb} {resource}"
             if namespace is not None:
                 msg += f" in namespace '{namespace}'"
             raise Forbidden(msg)
+
+    def _access_review_cached(self, user: str, verb: str, resource: str,
+                              namespace: str | None) -> bool:
+        if self.authz_cache_ttl <= 0:
+            return self.api.access_review(user, verb, resource, namespace)
+        import time
+        key = (user, verb, resource, namespace)
+        hit = self._authz_cache.get(key)
+        now = time.monotonic()
+        if hit is not None and hit[1] > now:
+            return hit[0]
+        allowed = self.api.access_review(user, verb, resource, namespace)
+        self._authz_cache[key] = (allowed, now + self.authz_cache_ttl)
+        if len(self._authz_cache) > 4096:  # bound a hostile user sweep
+            # snapshot first: other werkzeug threads insert concurrently
+            self._authz_cache = {k: v for k, v in
+                                 list(self._authz_cache.items())
+                                 if v[1] > now}
+        return allowed
 
     # ---- envelopes ---------------------------------------------------
     def success(self, req: Request, data_field: str | None = None,

@@ -25,6 +25,7 @@ import copy
 
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
 from kubeflow_rm_tpu.controlplane.api.meta import (
+    fast_deepcopy,
     annotations_of,
     deep_get,
     name_of,
@@ -51,7 +52,7 @@ class NotebookWebhook:
     def __call__(self, op: str, notebook: dict,
                  old: dict | None) -> dict | None:
         if op == "CREATE":
-            notebook = copy.deepcopy(notebook)
+            notebook = fast_deepcopy(notebook)
             self._inject_lock(notebook)
             self._resolve_image(notebook)
             self._mount_ca_bundle(notebook)

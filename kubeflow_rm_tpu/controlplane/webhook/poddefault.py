@@ -21,6 +21,7 @@ import copy
 
 from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
 from kubeflow_rm_tpu.controlplane.api.meta import (
+    fast_deepcopy,
     annotations_of,
     deep_get,
     labels_of,
@@ -47,7 +48,7 @@ class PodDefaultWebhook:
         if not matching:
             return None
         self._check_conflicts(pod, matching)
-        pod = copy.deepcopy(pod)
+        pod = fast_deepcopy(pod)
         for pd in matching:
             self._apply(pod, pd)
         return pod

@@ -26,6 +26,7 @@ import copy
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
 from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
 from kubeflow_rm_tpu.controlplane.api.meta import (
+    fast_deepcopy,
     annotations_of,
     deep_get,
     labels_of,
@@ -66,7 +67,7 @@ class TpuInjectWebhook:
         slice_id, worker_in_slice = divmod(ordinal, topo.hosts)
         slice_hosts = self._worker_hostnames(pod, topo, slice_id)
 
-        pod = copy.deepcopy(pod)
+        pod = fast_deepcopy(pod)
         spec = pod["spec"]
         for c in spec.get("containers") or []:
             env = c.setdefault("env", [])

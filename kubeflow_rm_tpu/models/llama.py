@@ -118,9 +118,23 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def bench_2_7b(**overrides) -> "LlamaConfig":
+        """~2.7B params: the measured largest full-fine-tune that fits
+        a 16 GiB v5e (params 2B + grads 2B ≈ 4 bytes/param with the
+        factored optimizer, plus recompute workspace)."""
+        return replace(
+            LlamaConfig(dim=3072, n_layers=22, n_heads=24, n_kv_heads=24,
+                        hidden_dim=8192, max_seq_len=2048),
+            **overrides,
+        )
+
+    @staticmethod
     def bench_3b(**overrides) -> "LlamaConfig":
-        """~3.1B params: the largest full-fine-tune that fits a 16 GiB
-        v5e (params + transient grads ≈ 4 bytes/param with adafactor)."""
+        """~3.1B params: one rung PAST the single-v5e wall — state
+        alone (params+grads ≈ 12.6 GiB) plus workspace/fragmentation
+        exceeds 15.75 GiB usable HBM even at full remat (the OOM row
+        in BENCH_SWEEP_r05); it exists to document the boundary and as
+        the first multi-chip-ladder config."""
         return replace(
             LlamaConfig(dim=3072, n_layers=26, n_heads=24, n_kv_heads=24,
                         hidden_dim=8192, max_seq_len=2048),

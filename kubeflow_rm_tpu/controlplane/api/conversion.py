@@ -1,0 +1,152 @@
+"""Multi-version Notebook API + conversion (the platform's API
+evolution story).
+
+The reference serves ``kubeflow.org/{v1alpha1,v1beta1,v1} Notebook``
+with conversion shims between structurally-identical types
+(``notebook-controller/api/v1beta1/notebook_types.go:27-34``,
+``api/v1/notebook_conversion.go:1-30`` — v1beta1 is the storage "hub",
+the others convert through it). This framework keeps two served
+versions with a REAL schema delta, because the TPU block is the field
+that actually evolved here:
+
+- ``v1`` (storage/hub): first-class ``spec.tpu {acceleratorType,
+  numSlices}`` — what every controller in this repo consumes.
+- ``v1beta1`` (served): the reference-era shape — no ``spec.tpu``;
+  TPU placement rides the ``notebooks.kubeflow.org/tpu-accelerator-
+  type`` / ``tpu-num-slices`` annotations (the same strings the
+  controller stamps on pods, so reference-era tooling already knows
+  them).
+
+Conversion is lossless both ways: v1beta1→v1 hoists the annotations
+into ``spec.tpu``; v1→v1beta1 demotes ``spec.tpu`` into the
+annotations. Everything else (the embedded PodSpec, status, behavior
+annotations) is version-invariant, exactly as in the reference.
+
+Served by two paths that must agree (tests assert both):
+
+- the apiextensions ConversionReview endpoint
+  (``deploy/webhook_server.py`` ``POST /convert``) — what a real
+  cluster calls;
+- the REST facade (``deploy/restserver.py``), which converts at the
+  collection boundary so a client reading
+  ``/apis/kubeflow.org/v1beta1/...`` sees v1beta1 objects over the
+  same store.
+"""
+
+from __future__ import annotations
+
+from kubeflow_rm_tpu.controlplane.api.meta import fast_deepcopy
+
+GROUP = "kubeflow.org"
+STORAGE_VERSION = "v1"
+SERVED_VERSIONS = ("v1beta1", "v1")
+
+#: v1beta1 carries the TPU block as annotations (not labels — these
+#: describe the CR itself; the controller separately stamps pod LABELS
+#: with the same suffixes for the webhook to read)
+TPU_ACCELERATOR_ANNOTATION = "notebooks.kubeflow.org/tpu-accelerator-type"
+TPU_NUM_SLICES_ANNOTATION = "notebooks.kubeflow.org/tpu-num-slices"
+
+
+def version_of(obj: dict) -> str:
+    api_version = obj.get("apiVersion") or f"{GROUP}/{STORAGE_VERSION}"
+    return api_version.rsplit("/", 1)[-1]
+
+
+def convert_notebook(obj: dict, to_version: str) -> dict:
+    """Convert a Notebook between served versions (hub = v1).
+
+    Returns a new object; the input is not mutated. Unknown versions
+    raise ValueError (a real conversion webhook answers those with a
+    Failure status)."""
+    if to_version not in SERVED_VERSIONS:
+        raise ValueError(f"unknown Notebook version {to_version!r} "
+                         f"(served: {', '.join(SERVED_VERSIONS)})")
+    cur = version_of(obj)
+    if cur not in SERVED_VERSIONS:
+        raise ValueError(f"cannot convert from unknown version {cur!r}")
+    out = fast_deepcopy(obj)
+    if cur == "v1beta1":
+        out = _v1beta1_to_hub(out)
+    if to_version == "v1beta1":
+        out = _hub_to_v1beta1(out)
+    out["apiVersion"] = f"{GROUP}/{to_version}"
+    return out
+
+
+def _v1beta1_to_hub(obj: dict) -> dict:
+    """Hoist the TPU annotations into first-class ``spec.tpu``. An
+    object that (illegally) carries both keeps ``spec.tpu`` — the
+    structured field is authoritative."""
+    ann = (obj.get("metadata") or {}).get("annotations") or {}
+    spec = obj.setdefault("spec", {})
+    acc = ann.pop(TPU_ACCELERATOR_ANNOTATION, None)
+    raw_slices = ann.pop(TPU_NUM_SLICES_ANNOTATION, None)
+    if acc and "tpu" not in spec:
+        tpu: dict = {"acceleratorType": acc}
+        if raw_slices is not None:
+            try:
+                n = int(raw_slices)
+            except ValueError as e:
+                raise ValueError(
+                    f"{TPU_NUM_SLICES_ANNOTATION}={raw_slices!r} is "
+                    "not an integer") from e
+            if n != 1:
+                tpu["numSlices"] = n
+        spec["tpu"] = tpu
+    if not ann and "annotations" in (obj.get("metadata") or {}):
+        obj["metadata"].pop("annotations", None)
+    elif ann:
+        obj["metadata"]["annotations"] = ann
+    return obj
+
+
+def _hub_to_v1beta1(obj: dict) -> dict:
+    """Demote ``spec.tpu`` into the annotations the reference-era
+    shape uses."""
+    spec = obj.get("spec") or {}
+    tpu = spec.pop("tpu", None)
+    if tpu:
+        ann = obj.setdefault("metadata", {}).setdefault(
+            "annotations", {})
+        ann[TPU_ACCELERATOR_ANNOTATION] = tpu["acceleratorType"]
+        n = int(tpu.get("numSlices", 1))
+        if n != 1:
+            ann[TPU_NUM_SLICES_ANNOTATION] = str(n)
+    return obj
+
+
+#: kind -> converter; the webhook server and REST facade both dispatch
+#: through this table, so adding a multi-version kind is one entry
+CONVERTERS = {"Notebook": convert_notebook}
+
+
+def convert_review(review: dict) -> dict:
+    """Answer an apiextensions.k8s.io/v1 ConversionReview request —
+    the wire protocol a real apiserver speaks to the conversion
+    webhook (strategy: Webhook in the CRD)."""
+    req = review.get("request") or {}
+    desired = (req.get("desiredAPIVersion") or "").rsplit("/", 1)[-1]
+    converted, err = [], None
+    for obj in req.get("objects") or []:
+        kind = obj.get("kind")
+        fn = CONVERTERS.get(kind)
+        if fn is None:
+            err = f"no conversion registered for kind {kind!r}"
+            break
+        try:
+            converted.append(fn(obj, desired))
+        except ValueError as e:
+            err = str(e)
+            break
+    resp: dict = {"uid": req.get("uid")}
+    if err is None:
+        resp["convertedObjects"] = converted
+        resp["result"] = {"status": "Success"}
+    else:
+        resp["result"] = {"status": "Failed", "message": err}
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "ConversionReview",
+        "response": resp,
+    }

@@ -122,9 +122,43 @@ def notebook_crd() -> dict:
         {"name": "Age", "type": "date",
          "jsonPath": ".metadata.creationTimestamp"},
     ]
-    return _crd("kubeflow.org", "Notebook", "notebooks",
-                [_version("v1", schema, printer_columns=cols)],
-                short_names=["nb"], categories=["kubeflow"])
+    # v1beta1: the reference-era shape — NO spec.tpu; TPU placement
+    # rides annotations (api/conversion.py hoists/demotes losslessly).
+    # Served for API evolution parity with the reference, which serves
+    # v1alpha1/v1beta1/v1 with conversion shims
+    # (notebook-controller/api/v1beta1/notebook_types.go:27-34,
+    # api/v1/notebook_conversion.go).
+    import copy as _copy
+    beta_schema = _copy.deepcopy(schema)
+    del beta_schema["properties"]["spec"]["properties"]["tpu"]
+    beta_cols = [
+        {"name": "Accelerator", "type": "string",
+         "jsonPath": ".metadata.annotations['notebooks\\.kubeflow\\."
+                     "org/tpu-accelerator-type']"},
+    ] + cols[1:]
+    crd = _crd("kubeflow.org", "Notebook", "notebooks",
+               [_version("v1beta1", beta_schema, storage=False,
+                         printer_columns=beta_cols),
+                _version("v1", schema, printer_columns=cols)],
+               short_names=["nb"], categories=["kubeflow"])
+    crd["spec"]["conversion"] = {
+        "strategy": "Webhook",
+        "webhook": {
+            "conversionReviewVersions": ["v1"],
+            "clientConfig": {
+                # same Service the admission configs point at
+                # (deploy/manifests.py webhook_objects)
+                "service": {
+                    "name": "webhook",
+                    "namespace": "kubeflow",
+                    "path": "/convert",
+                    "port": 443,
+                },
+                # caBundle patched in by the overlay / cert-manager
+            },
+        },
+    }
+    return crd
 
 
 def profile_crd() -> dict:

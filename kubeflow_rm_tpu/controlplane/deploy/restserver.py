@@ -31,6 +31,12 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from kubeflow_rm_tpu.controlplane.api.conversion import (
+    CONVERTERS,
+    GROUP,
+    SERVED_VERSIONS,
+    STORAGE_VERSION,
+)
 from kubeflow_rm_tpu.controlplane.apiserver import (
     AdmissionDenied,
     AlreadyExists,
@@ -70,9 +76,15 @@ class _Route:
     """Parsed collection/object path."""
 
     def __init__(self, kind: str, namespace: str | None,
-                 name: str | None, subresource: str | None):
+                 name: str | None, subresource: str | None,
+                 version: str | None = None):
         self.kind, self.namespace = kind, namespace
         self.name, self.subresource = name, subresource
+        # the API version the CLIENT asked for — multi-version kinds
+        # (conversion.CONVERTERS) are converted at this boundary, the
+        # way a real apiserver converts storage-version objects to the
+        # request's version
+        self.version = version
 
 
 def _parse_path(path: str) -> _Route | None:
@@ -80,9 +92,12 @@ def _parse_path(path: str) -> _Route | None:
     # /api/v1/... or /apis/<group>/<version>/...
     if not parts:
         return None
+    version = None
     if parts[0] == "api" and len(parts) >= 2:
+        version = parts[1]
         rest = parts[2:]
     elif parts[0] == "apis" and len(parts) >= 3:
+        version = parts[2]
         rest = parts[3:]
     else:
         return None
@@ -97,7 +112,7 @@ def _parse_path(path: str) -> _Route | None:
     kind = PLURALS[rest[0]]
     name = rest[1] if len(rest) > 1 else None
     sub = rest[2] if len(rest) > 2 else None
-    return _Route(kind, namespace, name, sub)
+    return _Route(kind, namespace, name, sub, version)
 
 
 class RestServer:
@@ -184,6 +199,41 @@ class RestServer:
             self._send(handler, 500,
                        _status(500, "InternalError", str(e)))
 
+    # ---- multi-version conversion at the serving boundary ------------
+    # (api/conversion.py): reads convert storage-version objects to the
+    # requested version; writes convert the client's version to storage
+    # before hitting the store — what a real apiserver does around its
+    # conversion webhook.
+    @staticmethod
+    def _needs_conversion(route: _Route) -> bool:
+        # identity (storage-version) requests skip the convert copy —
+        # this is the provision-latency hot path
+        return (route.kind in CONVERTERS and route.version is not None
+                and route.version != STORAGE_VERSION)
+
+    @classmethod
+    def _convert_out(cls, route: _Route, obj: dict) -> dict:
+        if not cls._needs_conversion(route):
+            return obj
+        try:
+            return CONVERTERS[route.kind](obj, route.version)
+        except ValueError as e:
+            raise Invalid(str(e)) from e
+
+    @classmethod
+    def _convert_in(cls, route: _Route, obj: dict) -> dict:
+        if not cls._needs_conversion(route):
+            return obj
+        # the path, not the body's apiVersion, names the version the
+        # client speaks — a real apiserver rejects mismatches; we
+        # normalize (a v1 apiVersion pasted into a v1beta1 POST must
+        # not make the annotations-shaped body skip conversion)
+        obj["apiVersion"] = f"{GROUP}/{route.version}"
+        try:
+            return CONVERTERS[route.kind](obj, STORAGE_VERSION)
+        except ValueError as e:
+            raise Invalid(str(e)) from e
+
     def _dispatch(self, handler, method: str, route: _Route,
                   params: dict) -> None:
         api, kind = self.api, route.kind
@@ -191,8 +241,9 @@ class RestServer:
             if params.get("watch", ["false"])[0] == "true":
                 self._serve_watch(handler, route, params)
                 return
-            items = api.list(kind, route.namespace,
-                             _selector_from(params))
+            items = [self._convert_out(route, o)
+                     for o in api.list(kind, route.namespace,
+                                       _selector_from(params))]
             self._send(handler, 200, {
                 "apiVersion": "v1", "kind": f"{kind}List",
                 "metadata": {"resourceVersion": str(api._rv)},
@@ -210,30 +261,64 @@ class RestServer:
             self._send_raw(handler, 200, text.encode(),
                            content_type="text/plain")
         elif method == "GET":
-            self._send(handler, 200,
-                       api.get(kind, route.name, route.namespace))
+            self._send(handler, 200, self._convert_out(
+                route, api.get(kind, route.name, route.namespace)))
         elif method == "POST":
             obj = self._read_json(handler)
             obj.setdefault("kind", kind)
             if route.namespace and not obj["metadata"].get("namespace"):
                 obj["metadata"]["namespace"] = route.namespace
-            self._send(handler, 201, api.create(obj))
+            obj = self._convert_in(route, obj)
+            self._send(handler, 201,
+                       self._convert_out(route, api.create(obj)))
         elif method == "PUT":
             obj = self._read_json(handler)
             obj.setdefault("kind", kind)
-            self._send(handler, 200, api.update(obj))
+            obj = self._convert_in(route, obj)
+            self._send(handler, 200,
+                       self._convert_out(route, api.update(obj)))
         elif method == "PATCH":
             patch = self._read_json(handler)
             if route.subresource == "status":
+                # status is version-invariant across served versions
                 current = api.get(kind, route.name, route.namespace)
                 current["status"] = patch.get("status", {})
                 self._send(handler, 200, api.update_status(current))
             else:
-                self._send(handler, 200,
-                           api.patch(kind, route.name, patch,
-                                     route.namespace))
+                if self._needs_conversion(route):
+                    # a merge-patch is expressed in the CLIENT's
+                    # version: apply it there, then convert the result
+                    # back to storage (what the real apiserver does).
+                    # The read-merge-write isn't under the store lock
+                    # like api.patch, so retry the rv CAS on Conflict
+                    # rather than surfacing a 409 the storage-version
+                    # path could never produce
+                    from kubeflow_rm_tpu.controlplane.api.meta import (
+                        strategic_merge,
+                    )
+                    for attempt in range(5):
+                        current = self._convert_out(
+                            route, api.get(kind, route.name,
+                                           route.namespace))
+                        merged = strategic_merge(current, patch)
+                        merged["metadata"]["resourceVersion"] = \
+                            current["metadata"]["resourceVersion"]
+                        merged = self._convert_in(route, merged)
+                        try:
+                            out = api.update(merged)
+                            break
+                        except Conflict:
+                            if attempt == 4:
+                                raise
+                    self._send(handler, 200,
+                               self._convert_out(route, out))
+                else:
+                    self._send(handler, 200,
+                               api.patch(kind, route.name, patch,
+                                         route.namespace))
         elif method == "DELETE":
-            obj = api.get(kind, route.name, route.namespace)
+            obj = self._convert_out(
+                route, api.get(kind, route.name, route.namespace))
             api.delete(kind, route.name, route.namespace)
             self._send(handler, 200, obj)
         else:
@@ -241,6 +326,13 @@ class RestServer:
                        _status(405, "MethodNotAllowed", method))
 
     def _serve_watch(self, handler, route: _Route, params: dict) -> None:
+        if (route.kind in CONVERTERS and route.version is not None
+                and route.version not in SERVED_VERSIONS):
+            # reject BEFORE the 200 + chunked headers go out — a
+            # conversion error mid-stream would interleave a second
+            # HTTP response into the open body
+            raise Invalid(f"{route.kind} has no served version "
+                          f"{route.version!r}")
         q: queue.Queue = queue.Queue()
         try:
             since_rv = int(params.get("resourceVersion", ["0"])[0] or 0)
@@ -292,6 +384,12 @@ class RestServer:
                         (evt["object"].get("metadata") or {})
                         .get("namespace")) != route.namespace:
                     continue
+                # multi-version kinds: the stream speaks the version
+                # the client's path asked for (evt dicts are shared
+                # across subscriber queues — convert a copy)
+                out_obj = self._convert_out(route, evt["object"])
+                if out_obj is not evt["object"]:
+                    evt = dict(evt, object=out_obj)
                 write_chunk(json.dumps(evt).encode() + b"\n")
             write_chunk(b"")  # terminal chunk
         except (BrokenPipeError, ConnectionResetError):

@@ -13,6 +13,9 @@ SAME three webhook classes the in-memory apiserver chains
 - ``POST /mutate-pod``      — PodDefaultWebhook then TpuInjectWebhook,
   in that order (PodDefault merge first, so TPU rendezvous env wins
   conflicts — the same order ``make_control_plane`` registers them)
+- ``POST /convert``         — apiextensions ConversionReview for the
+  multi-version Notebook CRD (``api/conversion.py``; the reference's
+  ``api/*/notebook_conversion.go`` equivalents)
 
 The mutation is returned as an RFC 6902 JSONPatch computed by diffing
 the incoming object against the webhook chain's output, exactly how
@@ -144,6 +147,14 @@ class WebhookServer:
                     review = json.loads(self.rfile.read(length))
                 except Exception:
                     self._send(400, {"error": "bad AdmissionReview"})
+                    return
+                if self.path == "/convert":
+                    # apiextensions ConversionReview (multi-version
+                    # CRDs; strategy: Webhook in the Notebook CRD)
+                    from kubeflow_rm_tpu.controlplane.api.conversion import (
+                        convert_review,
+                    )
+                    self._send(200, convert_review(review))
                     return
                 if self.path not in handler.chains:
                     self._send(404, {"error": f"no webhook at "

@@ -1,0 +1,249 @@
+"""Multi-version Notebook CRD: conversion round-trips, the
+ConversionReview webhook endpoint, and version-converted serving
+through the REST facade.
+
+Reference parity: the reference serves kubeflow.org/{v1alpha1,v1beta1,
+v1} Notebook with conversion shims
+(notebook-controller/api/v1beta1/notebook_types.go:27-34,
+api/v1/notebook_conversion.go:1-30). Here v1beta1 is the reference-era
+shape (TPU via annotations) and v1 carries first-class spec.tpu; the
+CRD declares strategy: Webhook at /convert.
+"""
+
+import threading
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane.api.conversion import (
+    SERVED_VERSIONS,
+    STORAGE_VERSION,
+    TPU_ACCELERATOR_ANNOTATION,
+    TPU_NUM_SLICES_ANNOTATION,
+    convert_notebook,
+    convert_review,
+)
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+
+
+def _v1_nb(**kw):
+    return make_notebook("conv", "ns", accelerator_type="v5p-16",
+                         num_slices=2, **kw)
+
+
+def test_v1_to_v1beta1_demotes_tpu_to_annotations():
+    nb = _v1_nb()
+    beta = convert_notebook(nb, "v1beta1")
+    assert beta["apiVersion"] == "kubeflow.org/v1beta1"
+    assert "tpu" not in beta["spec"]
+    ann = beta["metadata"]["annotations"]
+    assert ann[TPU_ACCELERATOR_ANNOTATION] == "v5p-16"
+    assert ann[TPU_NUM_SLICES_ANNOTATION] == "2"
+    # the embedded PodSpec is version-invariant
+    assert beta["spec"]["template"] == nb["spec"]["template"]
+    # input not mutated
+    assert nb["spec"]["tpu"]["acceleratorType"] == "v5p-16"
+
+
+def test_round_trip_is_lossless_both_ways():
+    nb = _v1_nb(annotations={"user-note": "keep me"})
+    beta = convert_notebook(nb, "v1beta1")
+    back = convert_notebook(beta, "v1")
+    assert back == nb
+    # and starting from v1beta1
+    beta2 = convert_notebook(back, "v1beta1")
+    assert beta2 == beta
+    assert beta2["metadata"]["annotations"]["user-note"] == "keep me"
+
+
+def test_cpu_notebook_converts_cleanly():
+    nb = make_notebook("cpu", "ns")
+    beta = convert_notebook(nb, "v1beta1")
+    assert "annotations" not in beta["metadata"]
+    assert convert_notebook(beta, "v1") == nb
+
+
+def test_identity_conversion_and_unknown_versions():
+    nb = _v1_nb()
+    same = convert_notebook(nb, "v1")
+    assert same == nb and same is not nb
+    with pytest.raises(ValueError):
+        convert_notebook(nb, "v2")
+    bad = dict(nb, apiVersion="kubeflow.org/v0")
+    with pytest.raises(ValueError):
+        convert_notebook(bad, "v1")
+
+
+def test_spec_tpu_wins_over_stray_annotations():
+    """An (illegal) v1beta1 object carrying BOTH the annotations and a
+    preserved spec.tpu keeps the structured field."""
+    beta = convert_notebook(_v1_nb(), "v1beta1")
+    beta["spec"]["tpu"] = {"acceleratorType": "v5litepod-4"}
+    v1 = convert_notebook(beta, "v1")
+    assert v1["spec"]["tpu"]["acceleratorType"] == "v5litepod-4"
+    assert TPU_ACCELERATOR_ANNOTATION not in (
+        v1["metadata"].get("annotations") or {})
+
+
+def test_bad_slices_annotation_is_an_error():
+    beta = convert_notebook(_v1_nb(), "v1beta1")
+    beta["metadata"]["annotations"][TPU_NUM_SLICES_ANNOTATION] = "lots"
+    with pytest.raises(ValueError, match="not an integer"):
+        convert_notebook(beta, "v1")
+
+
+def test_conversion_review_protocol():
+    nb = _v1_nb()
+    review = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "ConversionReview",
+        "request": {
+            "uid": "u-1",
+            "desiredAPIVersion": "kubeflow.org/v1beta1",
+            "objects": [nb, make_notebook("cpu", "ns")],
+        },
+    }
+    out = convert_review(review)
+    resp = out["response"]
+    assert resp["uid"] == "u-1"
+    assert resp["result"]["status"] == "Success"
+    assert len(resp["convertedObjects"]) == 2
+    assert all(o["apiVersion"] == "kubeflow.org/v1beta1"
+               for o in resp["convertedObjects"])
+    # failure shape
+    bad = dict(review, request=dict(review["request"],
+                                    desiredAPIVersion="kubeflow.org/v9"))
+    out = convert_review(bad)
+    assert out["response"]["result"]["status"] == "Failed"
+
+
+def test_convert_endpoint_on_webhook_server():
+    """POST /convert speaks ConversionReview over HTTP — what the CRD's
+    strategy: Webhook clientConfig points at."""
+    import json
+    import urllib.request
+
+    from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+    from kubeflow_rm_tpu.controlplane.deploy.webhook_server import (
+        WebhookServer, make_admission_handler,
+    )
+
+    srv = WebhookServer(make_admission_handler(APIServer()), port=0)
+    port = srv.start()
+    try:
+        body = json.dumps({
+            "request": {"uid": "x",
+                        "desiredAPIVersion": "kubeflow.org/v1beta1",
+                        "objects": [_v1_nb()]},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/convert", data=body,
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["kind"] == "ConversionReview"
+        obj = out["response"]["convertedObjects"][0]
+        assert obj["metadata"]["annotations"][
+            TPU_ACCELERATOR_ANNOTATION] == "v5p-16"
+    finally:
+        srv.stop()
+
+
+def test_rest_facade_serves_both_versions_over_one_store():
+    """Create via the v1beta1 path (annotations), read it back as v1
+    (spec.tpu) and v1beta1; the controller reconciles the stored v1
+    object into a real slice either way."""
+    from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+    from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+    from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+        make_tpu_node,
+    )
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+    )
+    from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+
+    capi = APIServer()
+    capi.ensure_namespace("ns")
+    rest = RestServer(capi)
+    rest.start()
+    try:
+        kapi = KubeAPIServer(rest.url)
+        beta_obj = {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {
+                "name": "legacy", "namespace": "ns",
+                "annotations": {
+                    TPU_ACCELERATOR_ANNOTATION: "v5p-16",
+                    TPU_NUM_SLICES_ANNOTATION: "2",
+                },
+            },
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "legacy", "image": "jupyter-jax:latest"}]}}},
+        }
+        # POST through the v1beta1 collection path
+        import json as _json
+
+        sess = kapi._session
+        resp = sess.post(
+            f"{rest.url}/apis/kubeflow.org/v1beta1/namespaces/ns/"
+            "notebooks", json=beta_obj)
+        assert resp.status_code == 201, resp.text
+        created = resp.json()
+        # the response speaks v1beta1 back
+        assert created["apiVersion"] == "kubeflow.org/v1beta1"
+        assert "tpu" not in created["spec"]
+
+        # stored as v1 with first-class spec.tpu
+        stored = capi.get("Notebook", "legacy", "ns")
+        assert stored["spec"]["tpu"] == {"acceleratorType": "v5p-16",
+                                         "numSlices": 2}
+        assert TPU_ACCELERATOR_ANNOTATION not in (
+            stored["metadata"].get("annotations") or {})
+
+        # GET via v1 path -> spec.tpu; GET via v1beta1 -> annotations
+        v1 = sess.get(f"{rest.url}/apis/kubeflow.org/v1/namespaces/ns/"
+                      "notebooks/legacy").json()
+        assert v1["spec"]["tpu"]["acceleratorType"] == "v5p-16"
+        beta = sess.get(f"{rest.url}/apis/kubeflow.org/v1beta1/"
+                        "namespaces/ns/notebooks/legacy").json()
+        assert beta["metadata"]["annotations"][
+            TPU_NUM_SLICES_ANNOTATION] == "2"
+        assert "tpu" not in beta["spec"]
+
+        # list via v1beta1 converts every item
+        lst = sess.get(f"{rest.url}/apis/kubeflow.org/v1beta1/"
+                       "namespaces/ns/notebooks").json()
+        assert all("tpu" not in it["spec"] for it in lst["items"])
+
+        # a merge-patch expressed in v1beta1 (annotation bump) lands
+        # in the stored v1 object as spec.tpu
+        resp = sess.patch(
+            f"{rest.url}/apis/kubeflow.org/v1beta1/namespaces/ns/"
+            "notebooks/legacy",
+            json={"metadata": {"annotations": {
+                TPU_NUM_SLICES_ANNOTATION: "4"}}},
+            headers={"Content-Type": "application/merge-patch+json"})
+        assert resp.status_code == 200, resp.text
+        assert capi.get("Notebook", "legacy", "ns")["spec"]["tpu"][
+            "numSlices"] == 4
+    finally:
+        rest.stop()
+
+
+def test_notebook_crd_declares_both_versions_and_conversion():
+    from kubeflow_rm_tpu.controlplane.deploy.crds import notebook_crd
+
+    crd = notebook_crd()
+    versions = {v["name"]: v for v in crd["spec"]["versions"]}
+    assert set(versions) == set(SERVED_VERSIONS)
+    assert versions[STORAGE_VERSION]["storage"] is True
+    assert versions["v1beta1"]["storage"] is False
+    assert versions["v1beta1"]["served"] is True
+    # the beta schema has no spec.tpu (that's the conversion's job)
+    beta_spec = versions["v1beta1"]["schema"]["openAPIV3Schema"][
+        "properties"]["spec"]["properties"]
+    assert "tpu" not in beta_spec
+    conv = crd["spec"]["conversion"]
+    assert conv["strategy"] == "Webhook"
+    assert conv["webhook"]["clientConfig"]["service"]["path"] == \
+        "/convert"

@@ -56,7 +56,10 @@ def test_notebook_crd_accelerator_enum_tracks_topology_table():
     the enum is rendered live from api/tpu.py."""
     crd = [c for c in all_crds()
            if c["metadata"]["name"] == "notebooks.kubeflow.org"][0]
-    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    # versions[0] is now v1beta1 (no spec.tpu by design); the enum
+    # lives on the storage version
+    schema = [v for v in crd["spec"]["versions"]
+              if v["storage"]][0]["schema"]["openAPIV3Schema"]
     enum = schema["properties"]["spec"]["properties"]["tpu"][
         "properties"]["acceleratorType"]["enum"]
     assert set(enum) == set(tpu_api.TOPOLOGIES)

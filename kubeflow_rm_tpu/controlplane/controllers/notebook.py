@@ -280,23 +280,36 @@ class NotebookController(Controller):
 
     # -- event re-emission (ref :662-736) ------------------------------
     def _reemit_pod_events(self, api: APIServer, notebook: dict) -> None:
+        """Surface Warning events of the notebook's Pods AND its
+        StatefulSet onto the Notebook CR — the reference's watch
+        predicate covers both (``isStsOrPodEvent``,
+        ``notebook_controller.go:700-736``), and the STS is where
+        slice-level failures land (SliceAdmissionFailed,
+        FailedCreate)."""
         name, ns = name_of(notebook), notebook["metadata"]["namespace"]
-        pods = api.list("Pod", ns, {"matchLabels":
-                                    {nb_api.NOTEBOOK_NAME_LABEL: name}})
         already = {
             (e.get("reason"), e.get("message"))
             for e in api.events_for(notebook)
         }
+
+        def reemit(ev, source):
+            if ev.get("type") != "Warning":
+                return  # only surface problems, as the ref predicate does
+            sig = (ev.get("reason"), f"[{source}] {ev.get('message')}")
+            if sig in already:
+                return
+            already.add(sig)
+            api.record_event(notebook, "Warning", sig[0], sig[1])
+
+        pods = api.list("Pod", ns, {"matchLabels":
+                                    {nb_api.NOTEBOOK_NAME_LABEL: name}})
         for pod in pods:
             for ev in api.events_for(pod):
-                if ev.get("type") != "Warning":
-                    continue  # only surface problems, as the ref predicate does
-                sig = (ev.get("reason"),
-                       f"[pod {name_of(pod)}] {ev.get('message')}")
-                if sig in already:
-                    continue
-                already.add(sig)
-                api.record_event(notebook, "Warning", sig[0], sig[1])
+                reemit(ev, f"pod {name_of(pod)}")
+        sts = api.try_get("StatefulSet", name, ns)
+        if sts is not None:
+            for ev in api.events_for(sts):
+                reemit(ev, f"sts {name}")
 
 
 def _map_event_to_notebook(event_obj: dict):
@@ -305,6 +318,9 @@ def _map_event_to_notebook(event_obj: dict):
         # pod name {notebook}-{ordinal}
         base = inv["name"].rsplit("-", 1)[0]
         return [Request(inv.get("namespace"), base)]
+    if inv.get("kind") == "StatefulSet" and inv.get("name"):
+        # the notebook's STS shares its name
+        return [Request(inv.get("namespace"), inv["name"])]
     return []
 
 

@@ -81,6 +81,15 @@ class SliceHealthController(Controller):
             nb, "Warning", "SliceRestart",
             f"TPU slice unhealthy ({reason}); restarting all {hosts} "
             "hosts — a slice recovers whole or not at all")
-        for p in pods:
-            api.delete("Pod", name_of(p), req.namespace)
+        # tear down by ORDINAL NAME, not by "pods currently visible":
+        # this controller reads through an informer cache, and during a
+        # churn the cache can momentarily show a partial slice — a
+        # visibility-based sweep would then leave survivors, breaking
+        # the whole-or-not-at-all guarantee (deletes of already-gone
+        # ordinals are NotFound no-ops)
+        for i in range(hosts):
+            try:
+                api.delete("Pod", f"{req.name}-{i}", req.namespace)
+            except NotFound:
+                pass
         return None

@@ -254,7 +254,11 @@ class StatefulSetController(Controller):
                 # pre-pinned (RWO node affinity) or already scheduled:
                 # the kubelet half still owes it a Running status
                 if (self.auto_ready
-                        and deep_get(pod, "status", "phase") != "Running"):
+                        and deep_get(pod, "status", "phase")
+                        not in ("Running", "Failed")):
+                    # Failed pods stay failed — recovery is the
+                    # slice-health controller's whole-slice decision,
+                    # and a real kubelet never resurrects a Failed pod
                     self.mark_running(api, pod)
                 continue
             node = self._pick_node(api, pod, nodes, used)

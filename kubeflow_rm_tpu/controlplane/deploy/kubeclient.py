@@ -46,6 +46,11 @@ from kubeflow_rm_tpu.controlplane.apiserver import (
 
 log = logging.getLogger("kubeflow_rm_tpu.kubeclient")
 
+
+class _WatchExpired(Exception):
+    """410 Gone from the watch: the resume rv fell below the server's
+    backlog horizon — only a full relist can resync."""
+
 SA_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"
 SA_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 
@@ -597,14 +602,24 @@ class KubeAPIServer:
         into the registered watchers. Run one thread per kind — the
         controller manager entrypoint does."""
         stop = stop or threading.Event()
+        rv: str | None = None
         while not stop.is_set():
             try:
-                rv = self._initial_list(kind, namespace)
-                self._stream(kind, namespace, rv, stop, timeout_s)
+                if rv is None:
+                    rv = self._initial_list(kind, namespace)
+                # resume from the last seen rv on stream restart — the
+                # server's backlog replays anything that landed in the
+                # gap, so no event (crucially DELETEDs, which a relist
+                # cannot re-synthesize) is ever lost; a 410 Gone (rv
+                # below the backlog horizon) falls back to a relist
+                rv = self._stream(kind, namespace, rv, stop, timeout_s)
             except (NotFound, Invalid):
                 raise  # misconfigured kind: crash loudly
+            except _WatchExpired as e:
+                log.info("watch %s: %s; relisting", kind, e)
+                rv = None
             except Exception as e:
-                log.warning("watch %s: %s; relisting in 2s", kind, e)
+                log.warning("watch %s: %s; retrying in 2s", kind, e)
                 stop.wait(2.0)
 
     def _initial_list(self, kind: str, namespace: str | None) -> str:
@@ -629,7 +644,9 @@ class KubeAPIServer:
         return body.get("metadata", {}).get("resourceVersion", "")
 
     def _stream(self, kind: str, namespace: str | None, rv: str,
-                stop: threading.Event, timeout_s: int) -> None:
+                stop: threading.Event, timeout_s: int) -> str:
+        """One watch stream; returns the last resourceVersion seen so
+        the next stream resumes without a relist (informer resume)."""
         params = {"watch": "true",
                   "timeoutSeconds": str(timeout_s),
                   "allowWatchBookmarks": "true"}
@@ -638,11 +655,16 @@ class KubeAPIServer:
         resp = self._session.get(
             self._collection_url(kind, namespace), params=params,
             stream=True, timeout=timeout_s + 10)
+        if resp.status_code == 410:
+            # a real apiserver can reject the resume rv with a direct
+            # HTTP 410 after compaction (client-go handles both forms)
+            raise _WatchExpired(f"HTTP 410 resuming {kind} at rv {rv}")
         self._raise_for(resp, f"watch {kind}")
+        last_rv = rv
         for line in resp.iter_lines():
             if stop.is_set():
                 resp.close()
-                return
+                return last_rv
             if not line:
                 continue
             evt = json.loads(line)
@@ -650,9 +672,13 @@ class KubeAPIServer:
             if etype == "BOOKMARK":
                 continue
             if etype == "ERROR":  # expired rv -> relist
-                raise RuntimeError(f"watch error: {obj}")
+                raise _WatchExpired(str(obj.get("message") or obj))
             obj.setdefault("kind", kind)
+            seen = (obj.get("metadata") or {}).get("resourceVersion")
+            if seen:
+                last_rv = seen
             self._fan(etype, obj)
+        return last_rv
 
     def _fan(self, etype: str, obj: dict) -> None:
         if self._cache_reads:

@@ -76,6 +76,11 @@ class Manager:
     """Runs controllers against an APIServer until the system is idle."""
 
     MAX_RETRIES = 5
+    # resourceVersion conflicts are EXPECTED under cached reads (the
+    # informer lags writes by a watch event) and always resolve once
+    # the cache catches up — give them a far larger budget than real
+    # reconcile errors, as controller-runtime's rate limiter does
+    MAX_CONFLICT_RETRIES = 40
 
     def __init__(self, api: APIServer):
         import threading
@@ -148,7 +153,8 @@ class Manager:
                 count += 1
                 try:
                     requeue_after = c.reconcile(self.api, req)
-                    self._retries.pop((c.name, req), None)
+                    self._retries.pop((c.name, req, False), None)
+                    self._retries.pop((c.name, req, True), None)
                     if requeue_after is not None:
                         due = self.api.clock() + datetime.timedelta(
                             seconds=requeue_after)
@@ -253,7 +259,8 @@ class Manager:
             try:
                 requeue_after = c.reconcile(self.api, req)
                 with self._queue_lock:
-                    self._retries.pop((c.name, req), None)
+                    self._retries.pop((c.name, req, False), None)
+                    self._retries.pop((c.name, req, True), None)
                 if requeue_after is not None:
                     due = self.api.clock() + datetime.timedelta(
                         seconds=requeue_after)
@@ -277,11 +284,16 @@ class Manager:
     def _retry(self, c: Controller, req: Request, e: Exception) -> None:
         from kubeflow_rm_tpu.controlplane import metrics
         metrics.RECONCILE_ERRORS_TOTAL.labels(controller=c.name).inc()
-        k = (c.name, req)
+        conflict = isinstance(e, Conflict)
+        cap = self.MAX_CONFLICT_RETRIES if conflict else self.MAX_RETRIES
+        # conflicts and real errors keep SEPARATE counters: a key that
+        # absorbed many (expected) conflict retries must still get the
+        # full error budget for its first genuine failure
+        k = (c.name, req, conflict)
         with self._queue_lock:
             n = self._retries.get(k, 0) + 1
             self._retries[k] = n
-            give_up = n > self.MAX_RETRIES
+            give_up = n > cap
             if give_up:
                 self.errors.append((c.name, req, e))
         if not give_up:

@@ -1,0 +1,212 @@
+#!/usr/bin/env python3
+"""HTTP serving throughput + speculative-decode workload bench.
+
+Two campaigns, each printing one JSON line (appended to
+``BENCH_SWEEP_r05_raw.jsonl`` by the caller):
+
+- ``serve``: boot ``examples/serve_llama.py``'s app in-process on a
+  synthetic-weight model (``--preset`` / ``--quant``), fire N requests
+  at C concurrency from real HTTP clients, report warm tokens/sec and
+  latency percentiles — the 7B companion of r4's 1.2B ``serving_http``
+  block (VERDICT r5 item 2).
+- ``spec``: measure prompt-lookup speculative decoding on the workload
+  it was designed for — continuation of REPETITIVE text (code/docs
+  where the continuation echoes the prompt) — against plain fused
+  decode, reporting acceptance and net speedup (VERDICT r5 item 8).
+  The model is trained briefly on a tiny repetitive corpus so greedy
+  continuations actually repeat (random weights accept nothing —
+  that's r4's measured worst case, not the win case).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def serve_campaign(preset: str, quant: str | None, requests_n: int,
+                   concurrency: int, max_new: int) -> dict:
+    import jax
+    import numpy as np
+    from werkzeug.serving import make_server
+
+    from examples.serve_llama import make_app
+    from kubeflow_rm_tpu.models import LlamaConfig, init_params
+
+    cfg = getattr(LlamaConfig, preset)(param_dtype=jax.numpy.bfloat16) \
+        if jax.devices()[0].platform == "tpu" \
+        else getattr(LlamaConfig, preset)()
+    if quant:
+        from kubeflow_rm_tpu.models.quantize import init_params_quantized
+        params = init_params_quantized(cfg, jax.random.key(0),
+                                       bits=4 if quant == "int4" else 8)
+    else:
+        params = init_params(cfg, jax.random.key(0))
+
+    app = make_app(cfg, params, max_new_tokens=max_new, window_ms=8,
+                   max_batch=16)
+    httpd = make_server("127.0.0.1", 0, app, threaded=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_port}/generate"
+
+    rng = np.random.default_rng(0)
+    # one prompt-length bucket (96-127) like the r4 block
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            size=int(rng.integers(96, 128))).tolist()
+               for _ in range(requests_n)]
+
+    import urllib.request
+
+    def call(p):
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            url, data=json.dumps({"prompt": p}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = json.loads(urllib.request.urlopen(req, timeout=600).read())
+        assert len(body["tokens"]) == len(p) + max_new
+        return time.perf_counter() - t0
+
+    # warm: one concurrency-wide wave so the coalesced batch shapes
+    # (not just batch-1) compile BEFORE the timed region
+    warm_ts = [threading.Thread(target=call, args=(p,))
+               for p in prompts[:concurrency]]
+    for t in warm_ts:
+        t.start()
+    for t in warm_ts:
+        t.join()
+    call(prompts[0])  # and the solo shape
+
+    lat: list[float] = []
+    lock = threading.Lock()
+    idx = {"i": 1}
+
+    def worker():
+        while True:
+            with lock:
+                i = idx["i"]
+                if i >= len(prompts):
+                    return
+                idx["i"] = i + 1
+            d = call(prompts[i])
+            with lock:
+                lat.append(d)
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    n = len(lat)
+    return {
+        "metric": "serving_http",
+        "model": f"llama-{preset}" + (f" {quant}" if quant else " bf16"),
+        "requests": n,
+        "concurrency": concurrency,
+        "new_tokens_per_req": max_new,
+        "warm_requests_per_s": round(n / wall, 2),
+        "warm_gen_tokens_per_s": round(n * max_new / wall, 1),
+        "latency_p50_s": round(lat[n // 2], 2),
+        "latency_p95_s": round(lat[max(0, int(n * 0.95) - 1)], 2),
+        "batches": app.batcher.batches_run,
+    }
+
+
+def spec_campaign(preset: str, train_steps: int, max_new: int) -> dict:
+    """Train a small model on repetitive text, then decode
+    continuations of its own training prefixes — the prompt-lookup
+    decoder's intended workload — vs plain fused decode."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_rm_tpu.models import LlamaConfig
+    from kubeflow_rm_tpu.models.generate import (
+        generate_fused, generate_speculative_fused,
+    )
+    from kubeflow_rm_tpu.parallel import MeshConfig, make_mesh
+    from kubeflow_rm_tpu.training.train import (
+        TrainConfig, init_train_state, make_train_step, shard_batch,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = getattr(LlamaConfig, preset)(
+        **({"param_dtype": jnp.bfloat16} if on_tpu else {}))
+    mesh = make_mesh(MeshConfig(), jax.devices()[:1])
+    tc = TrainConfig(model=cfg)
+    state = init_train_state(tc, jax.random.key(0))
+    step = make_train_step(tc, mesh, state)
+
+    # a tiny repetitive corpus: short token phrases repeated many times
+    rng = np.random.default_rng(0)
+    phrases = [rng.integers(2, min(cfg.vocab_size, 200), size=8).tolist()
+               for _ in range(4)]
+    seq_len = min(cfg.max_seq_len, 256)
+    doc = []
+    while len(doc) < 8 * seq_len:
+        doc += phrases[rng.integers(0, len(phrases))]
+    toks = np.array(doc[:8 * seq_len], np.int32).reshape(8, seq_len)
+    batch = shard_batch(
+        {"tokens": toks, "labels": np.roll(toks, -1, 1)}, mesh)
+    for _ in range(train_steps):
+        state, metrics = step(state, batch)
+    loss = float(jax.device_get(metrics["loss"]))
+
+    # prompt = a training row prefix; greedy continuation repeats it
+    prompt = jnp.asarray(toks[:1, :96])
+
+    def timed(fn):
+        out = fn()
+        jax.device_get(np.asarray(out)[:, -1])
+        t0 = time.perf_counter()
+        out = fn()
+        jax.device_get(np.asarray(out)[:, -1])
+        return np.asarray(out), time.perf_counter() - t0
+
+    plain, t_plain = timed(lambda: generate_fused(
+        state.params, cfg, prompt, max_new_tokens=max_new))
+    spec, t_spec = timed(lambda: generate_speculative_fused(
+        state.params, cfg, prompt, max_new_tokens=max_new, lookup_n=3))
+    match = bool((plain[0, :spec.shape[1]] == spec[0]).all()) \
+        or bool((spec[0, :plain.shape[1]] == plain[0]).all())
+    return {
+        "metric": "speculative_repetitive_workload",
+        "model": f"llama-{preset}",
+        "train_steps": train_steps,
+        "final_loss": round(loss, 3),
+        "new_tokens": max_new,
+        "plain_ms_per_token": round(1e3 * t_plain / max_new, 2),
+        "spec_ms_per_token": round(1e3 * t_spec / max_new, 2),
+        "net_speedup": round(t_plain / t_spec, 2),
+        "outputs_match": match,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("campaign", choices=["serve", "spec"])
+    ap.add_argument("--preset", default="bench_1b")
+    ap.add_argument("--quant", choices=["int8", "int4"], default=None)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--train-steps", type=int, default=60)
+    args = ap.parse_args()
+    if args.campaign == "serve":
+        out = serve_campaign(args.preset, args.quant, args.requests,
+                             args.concurrency, args.max_new)
+    else:
+        out = spec_campaign(args.preset, args.train_steps, args.max_new)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

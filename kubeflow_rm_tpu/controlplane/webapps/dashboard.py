@@ -22,7 +22,7 @@ from __future__ import annotations
 from werkzeug.exceptions import BadRequest
 
 from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
-from kubeflow_rm_tpu.controlplane.api.meta import deep_get, parse_quantity
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get
 from kubeflow_rm_tpu.controlplane.api.profile import (
     KIND as PROFILE_KIND, OWNER_ANNOTATION, make_profile,
 )
@@ -46,10 +46,23 @@ DEFAULT_LINKS = {
 
 
 def create_app(api: APIServer, *, disable_auth: bool = False,
-               prefix: str = "", links: dict | None = None, **app_kwargs) -> WebApp:
+               prefix: str = "", links: dict | None = None,
+               metrics_backend: str | None = None,
+               history_interval_s: float = 10.0,
+               **app_kwargs) -> WebApp:
+    from kubeflow_rm_tpu.controlplane.webapps.metrics_service import (
+        MetricsHistory, make_metrics_service,
+    )
+
     app = WebApp("centraldashboard", api, prefix=prefix,
                  disable_auth=disable_auth, **app_kwargs)
     links = links or DEFAULT_LINKS
+    # pluggable chart data source (metrics_service_factory.ts
+    # equivalent) + the ring buffer behind utilization-over-time
+    metrics_svc = make_metrics_service(api, metrics_backend)
+    history = MetricsHistory(metrics_svc,
+                             interval_s=history_interval_s)
+    app.metrics_history = history
 
     # ---- api.ts surface ---------------------------------------------
     @app.route("/api/namespaces")
@@ -62,7 +75,9 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         evs = sorted(api.list("Event", namespace),
                      key=lambda e: e.get("lastTimestamp") or "",
                      reverse=True)
-        return {"events": evs}
+        # "activities" is what the SPA (and the reference's api.ts
+        # naming) reads; "events" kept for existing consumers
+        return {"events": evs, "activities": evs}
 
     @app.route("/api/dashboard-links")
     def get_links(req):
@@ -70,38 +85,19 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
 
     @app.route("/api/metrics")
     def get_metrics(req):
-        """TPU fleet utilization: the dashboard's resource charts
-        (reference queries Prometheus/Stackdriver —
-        ``prometheus_metrics_service.ts``; the equivalent numbers here
-        come straight from the inventory + scheduled pods)."""
-        per_type: dict[str, dict] = {}
-        used_by_node: dict[str, float] = {}
-        for pod in api.list("Pod"):
-            node = deep_get(pod, "spec", "nodeName")
-            if not node:
-                continue
-            chips = 0.0
-            for c in deep_get(pod, "spec", "containers", default=[]) or []:
-                amt = deep_get(c, "resources", "limits",
-                               tpu_api.GOOGLE_TPU_RESOURCE)
-                if amt is not None:
-                    chips += parse_quantity(amt)
-            if chips:
-                used_by_node[node] = used_by_node.get(node, 0.0) + chips
-        for node in api.list("Node"):
-            labels = node["metadata"].get("labels") or {}
-            accel = labels.get(tpu_api.NODE_LABEL_ACCELERATOR)
-            if not accel:
-                continue
-            alloc = parse_quantity(deep_get(
-                node, "status", "allocatable",
-                tpu_api.GOOGLE_TPU_RESOURCE, default=0))
-            entry = per_type.setdefault(accel, {"allocatable": 0.0,
-                                                "used": 0.0, "nodes": 0})
-            entry["allocatable"] += alloc
-            entry["used"] += used_by_node.get(node["metadata"]["name"], 0.0)
-            entry["nodes"] += 1
-        return {"tpu": per_type}
+        """TPU fleet utilization: the dashboard's resource numbers
+        (reference queries Prometheus/Stackdriver behind a factory —
+        ``metrics_service_factory.ts``; the backend here is pluggable
+        the same way, defaulting to live inventory)."""
+        return metrics_svc.snapshot()
+
+    @app.route("/api/metrics/history")
+    def get_metrics_history(req):
+        """Utilization over time for the dashboard charts (the
+        reference's ``resource-chart.js`` interval queries; here a
+        ring of snapshots sampled in-process)."""
+        return {"interval_s": history.interval_s,
+                "series": history.series()}
 
     # ---- api_workgroup.ts surface -----------------------------------
     @app.route("/api/workgroup/exists")

@@ -29,7 +29,7 @@ from werkzeug.exceptions import BadRequest, NotFound
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
 from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
 from kubeflow_rm_tpu.controlplane.api.meta import (
-    annotations_of, deep_get, set_annotation,
+    annotations_of, deep_get, fast_deepcopy, set_annotation,
 )
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer
 from kubeflow_rm_tpu.controlplane.webapps import status as status_mod
@@ -217,12 +217,16 @@ def set_environment(nb: dict, body: dict, defaults: dict) -> None:
         {"name": k, "value": str(v)} for k, v in env.items())
 
 
-def _materialize_volume(api: APIServer, ns: str, nb: dict,
-                        vol: dict) -> None:
-    """One workspace/data volume: create its PVC if newPvc, then mount."""
+def _mount_volume(ns: str, nb: dict, vol: dict) -> dict | None:
+    """Phase 1 of a workspace/data volume: fold the mount into the
+    template WITHOUT side effects; returns the PVC object to create
+    (phase 2) for newPvc volumes. Split so the PodDefault dry-run can
+    validate the FULL pod shape (mounts included) before any PVC
+    exists — a rejected spawn must leave nothing behind."""
     mount = vol.get("mount")
     if not mount:
         raise BadRequest("volume requires a 'mount' path")
+    pvc_to_create = None
     if "newPvc" in vol:
         pvc = copy.deepcopy(vol["newPvc"])
         name = deep_get(pvc, "metadata", "name", default="") or ""
@@ -231,7 +235,7 @@ def _materialize_volume(api: APIServer, ns: str, nb: dict,
         pvc["metadata"]["namespace"] = ns
         pvc.setdefault("apiVersion", "v1")
         pvc.setdefault("kind", "PersistentVolumeClaim")
-        api.create(pvc)
+        pvc_to_create = pvc
         claim = name
     elif "existingSource" in vol:
         claim = deep_get(vol, "existingSource", "persistentVolumeClaim",
@@ -246,9 +250,40 @@ def _materialize_volume(api: APIServer, ns: str, nb: dict,
         {"name": vol_name, "persistentVolumeClaim": {"claimName": claim}})
     _container(nb).setdefault("volumeMounts", []).append(
         {"mountPath": mount, "name": vol_name})
+    return pvc_to_create
 
 
 # --- the app ----------------------------------------------------------
+
+def _dry_run_poddefault_merge(api, namespace: str, nb: dict) -> None:
+    """Run the worker-pod shape the controller will render through the
+    PodDefault merge engine WITHOUT persisting anything; an atomic
+    conflict rejection becomes a spawn-time 400 (dry-run admission,
+    the reference's post.py:51-57 dry-run create)."""
+    from kubeflow_rm_tpu.controlplane.apiserver import AdmissionDenied
+    from kubeflow_rm_tpu.controlplane.webhook.poddefault import (
+        PodDefaultWebhook,
+    )
+    from kubeflow_rm_tpu.controlplane.api.meta import deep_get
+
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": f"{nb['metadata']['name']}-0",
+            "namespace": namespace,
+            "labels": dict(nb["metadata"].get("labels") or {}),
+            "annotations": dict(
+                deep_get(nb, "spec", "template", "metadata",
+                         "annotations", default={}) or {}),
+        },
+        "spec": fast_deepcopy(
+            deep_get(nb, "spec", "template", "spec", default={})),
+    }
+    try:
+        PodDefaultWebhook(api)("CREATE", pod, None)
+    except AdmissionDenied as e:
+        raise BadRequest(str(e)) from e
+
 
 def create_app(api: APIServer, *, config_path: str | None = None,
                disable_auth: bool = False, prefix: str = "", **app_kwargs) -> WebApp:
@@ -391,8 +426,18 @@ def create_app(api: APIServer, *, config_path: str | None = None,
                                    "workspaceVolume", optional=True)
         if workspace:
             vols.insert(0, workspace)
-        for vol in vols:
-            _materialize_volume(api, namespace, nb, vol)
+
+        # fold volume mounts into the template FIRST (no side
+        # effects), dry-run the PodDefault merge the pods will go
+        # through (the reference dry-run-creates before the real
+        # create — post.py:51-57), and only then create PVCs: a
+        # conflicting configuration or mountPath gets a 400 AT SPAWN,
+        # leaving nothing behind
+        pvcs = [pvc for vol in vols
+                for pvc in [_mount_volume(namespace, nb, vol)] if pvc]
+        _dry_run_poddefault_merge(api, namespace, nb)
+        for pvc in pvcs:
+            api.create(pvc)
 
         api.create(nb)
         return {"message": "Notebook created successfully."}

@@ -1,0 +1,233 @@
+"""Pluggable cluster-metrics backends for the dashboard.
+
+The reference abstracts its chart data source behind a factory that
+picks Prometheus or Stackdriver at boot
+(``centraldashboard/app/metrics_service_factory.ts``,
+``prometheus_metrics_service.ts``, ``stackdriver_metrics_service.ts``).
+Same shape here, with backends that fit the TPU platform:
+
+- ``inventory`` (default): compute fleet numbers straight from the
+  apiserver's Node/Pod/Notebook objects — zero extra infrastructure,
+  always available.
+- ``prometheus``: scrape a Prometheus text exposition endpoint (the
+  controller manager's ``/metrics``, or a real Prometheus federate
+  URL via ``KFRM_PROMETHEUS_URL``) and read the platform's own gauges
+  (``controlplane/metrics.py``).
+
+Both return the same ``snapshot()`` dict, and ``MetricsHistory`` rings
+snapshots for the dashboard's utilization-over-time charts (the
+reference's ``resource-chart.js`` backs onto interval queries; here
+the history lives in-process).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Protocol
+
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, parse_quantity
+
+
+class MetricsService(Protocol):
+    def snapshot(self) -> dict: ...
+
+
+class InventoryMetricsService:
+    """Fleet numbers from the store: per-accelerator-type chip
+    allocatable/used plus the summary counters the SPA pills show."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def snapshot(self) -> dict:
+        api = self.api
+        per_type: dict[str, dict] = {}
+        used_by_node: dict[str, float] = {}
+        for pod in api.list("Pod"):
+            node = deep_get(pod, "spec", "nodeName")
+            if not node:
+                continue
+            chips = 0.0
+            for c in deep_get(pod, "spec", "containers",
+                              default=[]) or []:
+                amt = deep_get(c, "resources", "limits",
+                               tpu_api.GOOGLE_TPU_RESOURCE)
+                if amt is not None:
+                    chips += parse_quantity(amt)
+            if chips:
+                used_by_node[node] = used_by_node.get(node, 0.0) + chips
+        nodes = 0
+        for node in api.list("Node"):
+            labels = node["metadata"].get("labels") or {}
+            accel = labels.get(tpu_api.NODE_LABEL_ACCELERATOR)
+            if not accel:
+                continue
+            nodes += 1
+            alloc = parse_quantity(deep_get(
+                node, "status", "allocatable",
+                tpu_api.GOOGLE_TPU_RESOURCE, default=0))
+            entry = per_type.setdefault(
+                accel, {"allocatable": 0.0, "used": 0.0, "nodes": 0})
+            entry["allocatable"] += alloc
+            entry["used"] += used_by_node.get(
+                node["metadata"]["name"], 0.0)
+            entry["nodes"] += 1
+        running = 0
+        for nb in api.list("Notebook"):
+            if (nb.get("status") or {}).get("readyReplicas"):
+                running += 1
+        return {
+            "tpu": per_type,
+            "metrics": {
+                "nodes": nodes,
+                "chips_capacity": sum(e["allocatable"]
+                                      for e in per_type.values()),
+                "chips_requested": sum(e["used"]
+                                       for e in per_type.values()),
+                "notebooks_running": running,
+            },
+        }
+
+
+class PrometheusMetricsService:
+    """Scrape the platform's own gauges from a Prometheus text
+    endpoint. Per-accelerator breakdown isn't available from the flat
+    gauges, so ``tpu`` is empty — the reference's Prometheus service
+    similarly serves only the aggregate chart queries."""
+
+    def __init__(self, url: str, timeout_s: float = 3.0):
+        self.url = url
+        self.timeout_s = timeout_s
+
+    def _scrape(self) -> dict[str, float]:
+        import urllib.request
+        out: dict[str, float] = {}
+        with urllib.request.urlopen(self.url,
+                                    timeout=self.timeout_s) as resp:
+            for raw in resp.read().decode().splitlines():
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                # exposition: `name value` or `name{labels} value` —
+                # federate appends a timestamp, and label VALUES may
+                # contain spaces, so split after the closing brace
+                if "}" in line:
+                    head, _, rest = line.partition("}")
+                    name = head.split("{", 1)[0].strip()
+                    fields = rest.split()
+                else:
+                    fields = line.split()
+                    name = fields[0] if fields else ""
+                    fields = fields[1:]
+                if not name or not fields:
+                    continue
+                try:
+                    out[name] = out.get(name, 0.0) + float(fields[0])
+                except ValueError:
+                    continue
+        return out
+
+    def snapshot(self) -> dict:
+        g = self._scrape()
+        return {
+            "tpu": {},
+            "metrics": {
+                "nodes": None,
+                "chips_capacity": None,
+                "chips_requested": g.get("tpu_chips_requested"),
+                "notebooks_running": g.get("notebook_running"),
+            },
+        }
+
+
+def make_metrics_service(api, backend: str | None = None,
+                         prometheus_url: str | None = None
+                         ) -> MetricsService:
+    """The factory (``metrics_service_factory.ts`` equivalent).
+    Backend from the arg or ``KFRM_METRICS_BACKEND``; unknown names
+    raise so a typo can't silently fall back."""
+    backend = backend or os.environ.get("KFRM_METRICS_BACKEND",
+                                        "inventory")
+    if backend == "inventory":
+        return InventoryMetricsService(api)
+    if backend == "prometheus":
+        url = prometheus_url or os.environ.get("KFRM_PROMETHEUS_URL")
+        if not url:
+            raise ValueError(
+                "prometheus metrics backend needs KFRM_PROMETHEUS_URL")
+        return PrometheusMetricsService(url)
+    raise ValueError(f"unknown metrics backend {backend!r} "
+                     "(inventory|prometheus)")
+
+
+class MetricsHistory:
+    """Ring buffer of timestamped snapshots behind the dashboard's
+    utilization-over-time charts. Samples on a daemon thread every
+    ``interval_s`` (0 = only on demand); ``series()`` also takes a
+    fresh sample when the last one is stale, so a just-opened
+    dashboard always has a current point."""
+
+    def __init__(self, service: MetricsService, *,
+                 interval_s: float = 10.0, capacity: int = 720):
+        self.service = service
+        self.interval_s = interval_s
+        self._ring: collections.deque = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread_started = False
+        self._thread_lock = threading.Lock()
+        # seed one point synchronously so a just-booted dashboard has
+        # a current sample; the polling thread starts LAZILY on the
+        # first history read, so apps that never chart never pay for
+        # (or leak) a sampler thread
+        try:
+            self.sample()
+        except Exception:  # noqa: BLE001 - charts are best-effort
+            pass
+
+    def _ensure_thread(self):
+        if self.interval_s <= 0 or self._thread_started:
+            return
+        with self._thread_lock:
+            if not self._thread_started:
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+                self._thread_started = True
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 - keep sampling
+                pass
+
+    def stop(self):
+        self._stop.set()
+
+    def sample(self) -> dict:
+        snap = self.service.snapshot()
+        m = snap.get("metrics") or {}
+        point = {"t": time.time(),
+                 "chips_used": m.get("chips_requested"),
+                 "chips_capacity": m.get("chips_capacity"),
+                 "notebooks_running": m.get("notebooks_running")}
+        with self._lock:
+            self._ring.append(point)
+        return point
+
+    def series(self, max_points: int = 360) -> list[dict]:
+        self._ensure_thread()
+        with self._lock:
+            fresh = (not self._ring or
+                     time.time() - self._ring[-1]["t"] >
+                     max(self.interval_s, 1.0))
+        if fresh:
+            self.sample()
+        with self._lock:
+            pts = list(self._ring)
+        return pts[-max_points:]

@@ -213,6 +213,83 @@ async function loadNamespaces() {
 
 // ---- home ------------------------------------------------------------
 
+// One single-series line panel: 2px line, recessive grid, crosshair +
+// tooltip on hover, optional dashed reference line with a direct
+// label, and a <details> data table for the no-color/screen-reader
+// path. One y-axis per panel — two measures of different scale get
+// two panels, never a dual axis.
+function lineChart(el, pts, { value, refValue, refLabel, unit }) {
+  const W = 520, H = 120, PX = 34, PY = 10;
+  const xs = pts.map((p) => p.t);
+  const ys = pts.map(value);
+  const ref = refValue ? refValue(pts[pts.length - 1]) : null;
+  const yMax = Math.max(1, ...ys.filter((v) => v != null),
+                        ref ?? 0) * 1.1;
+  const x0 = xs[0], x1 = xs[xs.length - 1] || x0 + 1;
+  const sx = (t) => PX + (W - PX - 6) *
+    (x1 === x0 ? 1 : (t - x0) / (x1 - x0));
+  const sy = (v) => H - PY - (H - 2 * PY) * (v / yMax);
+  const path = pts
+    .map((p, i) => `${i ? "L" : "M"}${sx(p.t).toFixed(1)},` +
+                   `${sy(value(p) || 0).toFixed(1)}`)
+    .join(" ");
+  const gridY = [0.5, 1].map((f) => {
+    const v = yMax * f / 1.1;
+    return `<line class="grid" x1="${PX}" x2="${W - 6}"
+        y1="${sy(v)}" y2="${sy(v)}"></line>
+      <text class="tick" x="${PX - 4}" y="${sy(v) + 3}">` +
+      `${Math.round(v)}</text>`;
+  }).join("");
+  const refLine = ref == null ? "" :
+    `<line class="ref" x1="${PX}" x2="${W - 6}" y1="${sy(ref)}"
+        y2="${sy(ref)}"></line>
+     <text class="ref-label" x="${W - 8}" y="${sy(ref) - 3}">` +
+     `${esc(refLabel)} ${Math.round(ref)}</text>`;
+  // a one-point series has no line extent: draw the point itself so
+  // a just-booted dashboard shows data, not a blank panel
+  const seed = pts.length === 1
+    ? `<circle class="seed" cx="${sx(xs[0])}" cy="${sy(ys[0] || 0)}"
+         r="3.5"></circle>` : "";
+  el.innerHTML = `
+    <svg viewBox="0 0 ${W} ${H}" class="tschart" role="img">
+      ${gridY}${refLine}
+      <path class="series" d="${path}"></path>${seed}
+      <line class="xhair" y1="${PY}" y2="${H - PY}" hidden></line>
+      <circle class="dot" r="3.5" hidden></circle>
+    </svg>
+    <div class="tooltip" hidden></div>
+    <details class="chart-data"><summary>data</summary>
+      <table><tbody>${pts.slice(-12).map((p) =>
+        `<tr><td>${new Date(p.t * 1e3).toLocaleTimeString()}</td>` +
+        `<td>${value(p) ?? "–"} ${esc(unit)}</td></tr>`).join("")}
+      </tbody></table></details>`;
+  const svg = el.querySelector("svg");
+  const tip = el.querySelector(".tooltip");
+  const xhair = el.querySelector(".xhair");
+  const dot = el.querySelector(".dot");
+  svg.addEventListener("mousemove", (ev) => {
+    const r = svg.getBoundingClientRect();
+    const t = x0 + (x1 - x0) *
+      ((ev.clientX - r.left) / r.width * W - PX) / (W - PX - 6);
+    let best = pts[0];
+    for (const p of pts) {
+      if (Math.abs(p.t - t) < Math.abs(best.t - t)) best = p;
+    }
+    const cx = sx(best.t), cy = sy(value(best) || 0);
+    xhair.setAttribute("x1", cx); xhair.setAttribute("x2", cx);
+    xhair.hidden = false;
+    dot.setAttribute("cx", cx); dot.setAttribute("cy", cy);
+    dot.hidden = false;
+    tip.hidden = false;
+    tip.textContent = `${new Date(best.t * 1e3).toLocaleTimeString()}` +
+      ` · ${value(best) ?? "–"} ${unit}`;
+    tip.style.left = `${Math.min(cx / W * 100, 70)}%`;
+  });
+  svg.addEventListener("mouseleave", () => {
+    tip.hidden = true; xhair.hidden = true; dot.hidden = true;
+  });
+}
+
 route(/^\/home$/, async () => {
   const ns = state.namespace;
   const [links, metrics, activities] = await Promise.all([
@@ -231,6 +308,16 @@ route(/^\/home$/, async () => {
         <span class="pill">${esc(m.chips_requested ?? "–")} chips in use</span>
         <span class="pill">${esc(m.notebooks_running ?? "–")} notebooks running</span>
       </div>
+      <div class="charts">
+        <div class="chart-panel">
+          <h3>TPU chips in use</h3>
+          <div id="chart-chips" class="chart"></div>
+        </div>
+        <div class="chart-panel">
+          <h3>Notebooks running</h3>
+          <div id="chart-notebooks" class="chart"></div>
+        </div>
+      </div>
     </div>
     <div class="card quick-links">
       <h2>Quick shortcuts</h2>
@@ -242,6 +329,20 @@ route(/^\/home$/, async () => {
       <h2>Recent activity <span class="pill">${esc(ns)}</span></h2>
       <table><tbody id="act"></tbody></table>
     </div>`;
+  try {
+    const hist = await get("/api/metrics/history");
+    const pts = hist.series || [];
+    if (pts.length) {
+      lineChart($("#chart-chips"), pts, {
+        value: (p) => p.chips_used,
+        refValue: (p) => p.chips_capacity, refLabel: "capacity",
+        unit: "chips",
+      });
+      lineChart($("#chart-notebooks"), pts, {
+        value: (p) => p.notebooks_running, unit: "notebooks",
+      });
+    }
+  } catch { /* charts are progressive enhancement */ }
   $("#act").innerHTML = (activities.activities || [])
     .slice(0, 12)
     .map((e) => `<tr>

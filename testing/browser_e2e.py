@@ -47,11 +47,26 @@ def serve_stack(port: int = 0):
     from kubeflow_rm_tpu.controlplane.webapps.gateway import make_gateway
 
     api, mgr = make_control_plane()
-    for h in range(lookup(ACCEL).hosts):
-        api.create(make_tpu_node(f"{ACCEL}-h{h}", ACCEL))
+    # TWO slices of inventory: the multislice scenario spans both, and
+    # fleet exhaustion (everything in use) is the pending-spawn setup
+    for s_ in range(2):
+        for h in range(lookup(ACCEL).hosts):
+            api.create(make_tpu_node(f"{ACCEL}-s{s_}-h{h}", ACCEL))
     api.create(make_profile(NS, USER))
     mgr.enqueue_all()
-    mgr.run_until_idle()
+    mgr.run_until_idle()  # the profile reconcile creates the namespace
+    # a conflicting PodDefault pair: selecting BOTH must 400 the spawn
+    # (the admission webhook's atomic merge-conflict rejection)
+    for name, val in (("hf-cache-a", "/cache/a"), ("hf-cache-b", "/cache/b")):
+        api.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+            "metadata": {"name": name, "namespace": NS},
+            "spec": {
+                "selector": {"matchLabels": {name: "true"}},
+                "desc": f"HF cache ({name})",
+                "env": [{"name": "HF_HOME", "value": val}],
+            },
+        })
 
     stop = threading.Event()
     threading.Thread(target=mgr.run_forever, args=(stop, 0.05),
@@ -65,10 +80,10 @@ def serve_stack(port: int = 0):
         stop.set()
         httpd.shutdown()
 
-    return f"http://127.0.0.1:{httpd.server_port}", shutdown
+    return f"http://127.0.0.1:{httpd.server_port}", shutdown, api
 
 
-def drive(url: str, headed: bool = False) -> None:
+def drive(url: str, api, headed: bool = False) -> None:
     """The e2e itself. Raises on any failed expectation."""
     from playwright.sync_api import expect, sync_playwright
 
@@ -78,10 +93,19 @@ def drive(url: str, headed: bool = False) -> None:
         page = browser.new_page()
         page.on("dialog", lambda d: d.accept())  # the delete confirm()
 
-        # home: fleet metrics render from /api/metrics
+        # home: fleet metrics render from /api/metrics — with NUMBERS
+        # (the pills regressed to "–" once; assert the contract), and
+        # the utilization-over-time charts draw from /api/metrics/history
         page.goto(url)
         expect(page.locator("#view .pill").first).to_contain_text(
-            "TPU nodes")
+            "4 TPU nodes")
+        expect(page.locator("#chart-chips svg.tschart")
+               ).to_be_visible()
+        expect(page.locator("#chart-notebooks svg.tschart")
+               ).to_be_visible()
+        # hover layer: crosshair + tooltip appear over the plot
+        page.hover("#chart-chips svg")
+        expect(page.locator("#chart-chips .tooltip")).to_be_visible()
 
         # spawner: name + slice chip + launch
         page.goto(f"{url}/#/notebooks/new")
@@ -129,6 +153,87 @@ def drive(url: str, headed: bool = False) -> None:
         expect(page.locator(f'tr[data-name="{nb}"]')
                ).to_have_count(0, timeout=30_000)
 
+        # ---- failure paths (VERDICT r5 item 6) -----------------------
+
+        # 1. PodDefault merge conflict: selecting BOTH HF_HOME configs
+        #    must 400 at admission and surface in the error toast
+        page.goto(f"{url}/#/notebooks/new")
+        page.fill("#f-name", "pd-conflict")
+        page.click(f'.slice-chip[data-accel="{ACCEL}"]')
+        page.click("details.field summary")  # the checkboxes live here
+        for box in page.locator(".f-poddefault").all():
+            box.check()
+        page.click('#spawn button[type="submit"]')
+        expect(page.locator("#toast")).to_be_visible()
+        expect(page.locator("#toast")).to_have_class("error")
+        expect(page.locator("#toast")).to_contain_text("HF_HOME")
+        expect(page.locator('tr[data-name="pd-conflict"]')
+               ).to_have_count(0)
+
+        # 2. quota-exceeded spawn: the slice is all-or-nothing rejected
+        #    and the row surfaces the warning status from the event
+        from kubeflow_rm_tpu.controlplane.api.tpu import (
+            GOOGLE_TPU_RESOURCE, lookup,
+        )
+        chips = lookup(ACCEL).chips_per_host
+        api.create({
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "tiny", "namespace": NS},
+            "spec": {"hard": {
+                f"requests.{GOOGLE_TPU_RESOURCE}": str(chips)}},
+        })
+        page.goto(f"{url}/#/notebooks/new")
+        page.fill("#f-name", "quota-denied")
+        page.click(f'.slice-chip[data-accel="{ACCEL}"]')
+        page.click('#spawn button[type="submit"]')
+        row = page.locator('tr[data-name="quota-denied"]')
+        expect(row).to_be_visible()
+        expect(row.locator(".status")).to_contain_text(
+            "warning", timeout=30_000)
+        page.click('tr[data-name="quota-denied"] '
+                   'button[data-act="delete"]')
+        expect(row).to_have_count(0, timeout=30_000)
+        api.delete("ResourceQuota", "tiny", NS)
+
+        # 3. multislice spawn: numSlices=2 renders hosts×2 pods, and
+        #    the per-ordinal logs carry the MEGASCALE (DCN) rendezvous
+        page.goto(f"{url}/#/notebooks/new")
+        page.fill("#f-name", "multi")
+        page.click(f'.slice-chip[data-accel="{ACCEL}"]')
+        page.fill("#f-numslices", "2")
+        page.click('#spawn button[type="submit"]')
+        expect(page.locator('tr[data-name="multi"] .status')
+               ).to_contain_text("ready", timeout=30_000)
+        page.click('tr[data-name="multi"] td:nth-child(2)')
+        hosts = lookup(ACCEL).hosts
+        expect(page.locator("#d-pods button[data-pod]")
+               ).to_have_count(hosts * 2)
+        page.click(f'#d-pods button[data-pod="{hosts}"]')  # slice 1
+        expect(page.locator("#d-logs")).to_contain_text(
+            "TPU_WORKER_ID=0", timeout=10_000)
+
+        # 4. stop-while-pending: the fleet is fully held by "multi", so
+        #    a new spawn sits un-schedulable — stopping it must work
+        #    cleanly from that pending state (no-restart guard path:
+        #    stopped notebooks change freely)
+        page.goto(f"{url}/#/notebooks/new")
+        page.fill("#f-name", "pending-nb")
+        page.click(f'.slice-chip[data-accel="{ACCEL}"]')
+        page.click('#spawn button[type="submit"]')
+        prow = page.locator('tr[data-name="pending-nb"]')
+        expect(prow).to_be_visible()
+        expect(prow.locator(".status")).not_to_contain_text(
+            "ready", timeout=5_000)
+        page.click('tr[data-name="pending-nb"] button[data-act="stop"]')
+        expect(prow.locator(".status")).to_contain_text(
+            "stopped", timeout=30_000)
+        page.click('tr[data-name="pending-nb"] '
+                   'button[data-act="delete"]')
+        expect(prow).to_have_count(0, timeout=30_000)
+        page.click('tr[data-name="multi"] button[data-act="delete"]')
+        expect(page.locator('tr[data-name="multi"]')
+               ).to_have_count(0, timeout=30_000)
+
         browser.close()
 
 
@@ -140,7 +245,7 @@ def main() -> int:
     ap.add_argument("--headed", action="store_true")
     args = ap.parse_args()
 
-    url, shutdown = serve_stack(args.port)
+    url, shutdown, api = serve_stack(args.port)
     print(f"gateway: {url}  (user: {USER}, namespace: {NS})", flush=True)
     if args.serve:
         try:
@@ -153,7 +258,7 @@ def main() -> int:
         return 0
 
     try:
-        drive(url, headed=args.headed)
+        drive(url, api, headed=args.headed)
     finally:
         shutdown()
     print("BROWSER E2E OK")

@@ -103,3 +103,84 @@ def test_metrics_endpoint_serves_prometheus_exposition(stack):
     body = resp.get_data(as_text=True)
     assert "notebook_running" in body
     assert "tpu_chips_requested" in body
+
+
+def test_metrics_summary_and_history(stack):
+    """/api/metrics carries the SPA's pill summary; /api/metrics/history
+    rings utilization-over-time points (reference resource-chart.js +
+    metrics_service_factory.ts equivalents)."""
+    api, mgr = stack
+    api.create(make_tpu_node("n0", "v5p-16"))
+    from kubeflow_rm_tpu.controlplane.webapps.dashboard import create_app
+    app = create_app(api, history_interval_s=0)  # on-demand sampling
+    client = app.test_client(user=USER)
+    body = get_json(client, "/api/metrics")
+    m = body["metrics"]
+    assert m["nodes"] >= 1 and m["chips_capacity"] >= 1
+    assert "notebooks_running" in m
+    hist = get_json(client, "/api/metrics/history")
+    assert hist["series"], "on-demand sample must produce a point"
+    pt = hist["series"][-1]
+    assert {"t", "chips_used", "chips_capacity",
+            "notebooks_running"} <= set(pt)
+    app.metrics_history.stop()
+
+
+def test_metrics_backend_factory():
+    """inventory | prometheus | unknown — the factory contract
+    (metrics_service_factory.ts)."""
+    import pytest as _pytest
+
+    from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+    from kubeflow_rm_tpu.controlplane.webapps.metrics_service import (
+        InventoryMetricsService, PrometheusMetricsService,
+        make_metrics_service,
+    )
+    api = APIServer()
+    assert isinstance(make_metrics_service(api, "inventory"),
+                      InventoryMetricsService)
+    svc = make_metrics_service(api, "prometheus",
+                               prometheus_url="http://x/metrics")
+    assert isinstance(svc, PrometheusMetricsService)
+    with _pytest.raises(ValueError, match="unknown metrics backend"):
+        make_metrics_service(api, "stackdriver-typo")
+    with _pytest.raises(ValueError, match="KFRM_PROMETHEUS_URL"):
+        make_metrics_service(api, "prometheus")
+
+
+def test_prometheus_backend_scrapes_platform_gauges(stack):
+    """The prometheus backend parses the platform's own exposition —
+    served here by a web app's /metrics route."""
+    import threading
+
+    from werkzeug.serving import make_server
+
+    from kubeflow_rm_tpu.controlplane import metrics as plat_metrics
+    from kubeflow_rm_tpu.controlplane.webapps.dashboard import create_app
+    from kubeflow_rm_tpu.controlplane.webapps.metrics_service import (
+        PrometheusMetricsService,
+    )
+    api, _ = stack
+    app = create_app(api, history_interval_s=0)
+    httpd = make_server("127.0.0.1", 0, app, threaded=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        plat_metrics.TPU_CHIPS_REQUESTED.set(12)
+        svc = PrometheusMetricsService(
+            f"http://127.0.0.1:{httpd.server_port}/metrics")
+        snap = svc.snapshot()
+        assert snap["metrics"]["chips_requested"] == 12.0
+    finally:
+        httpd.shutdown()
+        app.metrics_history.stop()
+
+
+def test_activities_carry_spa_key(stack):
+    api, _ = stack
+    api.ensure_namespace("team")
+    from kubeflow_rm_tpu.controlplane.webapps.dashboard import create_app
+    app = create_app(api, history_interval_s=0)
+    client = app.test_client(user=USER)
+    body = get_json(client, "/api/activities/team")
+    assert body["activities"] == body["events"]
+    app.metrics_history.stop()

@@ -336,3 +336,30 @@ def test_group_two_spawn_uses_rstudio_image(stack, app):
     ann = nb["metadata"]["annotations"]
     assert ann[nb_api.REWRITE_URI_ANNOTATION] == "/"
     assert ann[nb_api.SERVER_TYPE_ANNOTATION] == "group-two"
+
+
+def test_poddefault_conflict_rejected_at_spawn(stack):
+    """Selecting two PodDefaults whose merges collide 400s the spawn
+    POST itself (dry-run admission — reference post.py:51-57 dry-run
+    create), instead of a FailedCreate event minutes later."""
+    api, mgr = stack
+    for name, val in (("pd-a", "/a"), ("pd-b", "/b")):
+        api.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+            "metadata": {"name": name, "namespace": "team"},
+            "spec": {"selector": {"matchLabels": {name: "true"}},
+                     "desc": name,
+                     "env": [{"name": "HF_HOME", "value": val}]},
+        })
+    app = create_app(api)
+    client = app.test_client(user=USER)
+    resp = post_json(client, "/api/namespaces/team/notebooks",
+                     spawn_body(name="pd-clash",
+                                configurations=["pd-a", "pd-b"]))
+    assert resp.status_code == 400, resp.get_data()
+    assert b"HF_HOME" in resp.get_data()
+    assert api.try_get("Notebook", "pd-clash", "team") is None
+    # a single (non-conflicting) selection still spawns
+    resp = post_json(client, "/api/namespaces/team/notebooks",
+                     spawn_body(name="pd-ok", configurations=["pd-a"]))
+    assert resp.status_code == 200, resp.get_data()

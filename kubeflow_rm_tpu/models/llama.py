@@ -119,9 +119,11 @@ class LlamaConfig:
 
     @staticmethod
     def bench_2_7b(**overrides) -> "LlamaConfig":
-        """~2.7B params: the measured largest full-fine-tune that fits
-        a 16 GiB v5e (params 2B + grads 2B ≈ 4 bytes/param with the
-        factored optimizer, plus recompute workspace)."""
+        """~2.7B params: one rung PAST the measured single-v5e wall —
+        state (params+grads ≈ 10.8 GiB at 4 bytes/param) plus logits
+        and recompute workspace OOMs 15.75 GiB usable HBM even at
+        mb1/full remat (BENCH_SWEEP_r05 scale rows); bench_2b (~2.1B)
+        is the largest full fine-tune that fits."""
         return replace(
             LlamaConfig(dim=3072, n_layers=22, n_heads=24, n_kv_heads=24,
                         hidden_dim=8192, max_seq_len=2048),

@@ -33,6 +33,11 @@ Scenarios (each emits ok/skip + wall ms into the JSON artifact):
                        rejection, zero rump pods
   conversion           v1beta1 (annotation-shaped) create converts to
                        stored v1 spec.tpu and back on read
+  ha_failover          two elected managers; kill the leader without
+                       lease release — the standby takes over within
+                       the lease window and recreates a deleted
+                       StatefulSet; the apiserver write log proves no
+                       dead-leader write lands after takeover
   delete_cascade       deleting the CR garbage-collects every
                        satellite object
 
@@ -60,7 +65,7 @@ from kubeflow_rm_tpu.controlplane.api.notebook import (  # noqa: E402
 )
 from kubeflow_rm_tpu.controlplane.api.profile import make_profile  # noqa: E402
 from kubeflow_rm_tpu.controlplane.apiserver import (  # noqa: E402
-    AdmissionDenied, APIError, Invalid,
+    AdmissionDenied, APIError, Invalid, NotFound,
 )
 from kubeflow_rm_tpu.controlplane.controllers.authcompanion import (  # noqa: E402
     OAUTH_INJECT_ANNOTATION,
@@ -79,12 +84,15 @@ class Walk:
 
     def __init__(self, api, *, has_fake_kubelet: bool,
                  fast_culling: bool, rest_url: str | None = None,
-                 image: str = "jupyter-jax:latest"):
+                 image: str = "jupyter-jax:latest", ha=None,
+                 only: set | None = None):
         self.api = api
         self.has_fake_kubelet = has_fake_kubelet
         self.fast_culling = fast_culling
         self.rest_url = rest_url
         self.image = image
+        self.ha = ha
+        self.only = only
         self.results: list[dict] = []
         self.hosts = tpu_api.lookup(ACCEL).hosts
 
@@ -109,6 +117,8 @@ class Walk:
         raise AssertionError(f"timed out waiting for {what}")
 
     def run(self, name, fn, skip: str | None = None):
+        if self.only is not None and name not in self.only:
+            skip = skip or "filtered by --scenarios"
         t0 = time.perf_counter()
         rec = {"scenario": name}
         if skip:
@@ -345,6 +355,79 @@ class Walk:
         self.api.delete("Notebook", "legacy", NS)
         return {}
 
+    def ha_failover(self):
+        """Crash failover between two lease-elected managers.
+
+        The leader provisions a slice, then dies WITHOUT releasing its
+        Lease (crash semantics: ``release_on_exit=False``). The standby
+        must steal the expired lease within the lease window and prove
+        it reconciles by recreating a StatefulSet deleted out from
+        under the notebook. The apiserver write log (writer attribution
+        via X-Writer-Identity) then shows a clean hand-over: not a
+        single dead-leader write sequenced after the standby's first.
+        """
+        capi = self.ha["capi"]
+        mgrs = self.ha["managers"]
+
+        def sole_leader():
+            leaders = [m for m in mgrs if m["elector"].is_leader]
+            return leaders[0] if len(leaders) == 1 else None
+        lead = self.wait(sole_leader, timeout=15,
+                         what="exactly one elected leader")
+        standby = next(m for m in mgrs if m is not lead)
+
+        self.api.create(make_notebook(
+            "failover", NS, accelerator_type=ACCEL, image=self.image,
+            annotations={nb_api.CULLING_EXCLUDE_ANNOTATION: "true"}))
+        self.nb_ready("failover")
+
+        # crash the leader: stop its workers/watches/elector mid-term;
+        # the lease stays held until it expires on the wall clock
+        t_kill = time.perf_counter()
+        lead["stop"].set()
+        self.wait(lambda: standby["elector"].is_leader, timeout=15,
+                  what="standby takeover")
+        takeover_ms = round(1e3 * (time.perf_counter() - t_kill), 1)
+        el = lead["elector"]
+        bound_ms = 1e3 * (el.lease_duration_s + el.renew_deadline_s
+                          + 2 * el.retry_period_s)
+        assert takeover_ms <= bound_ms, \
+            f"takeover {takeover_ms}ms > bound {bound_ms}ms"
+
+        # the new leader must do real work: recreate a deleted slice
+        self.api.delete("StatefulSet", "failover", NS)
+        self.wait(lambda: self.api.try_get("StatefulSet", "failover",
+                                           NS),
+                  what="standby recreates StatefulSet")
+        self.nb_ready("failover")
+
+        log = list(capi.write_log)
+        standby_writes = [w["seq"] for w in log
+                          if w.get("writer") == standby["identity"]]
+        assert standby_writes, "standby never wrote"
+        first_standby = min(standby_writes)
+        dead_after = [w for w in log
+                      if w.get("writer") == lead["identity"]
+                      and w["seq"] > first_standby]
+        assert not dead_after, \
+            f"dead leader wrote after takeover: {dead_after[:3]}"
+        sts_creates = [w for w in log
+                       if w["kind"] == "StatefulSet"
+                       and w["verb"] == "CREATE"
+                       and w["name"] == "failover"]
+        # one per legitimate leader term — duplicates would mean an
+        # overlapping reconcile
+        assert len(sts_creates) == 2, sts_creates
+        assert {w.get("writer") for w in sts_creates} == \
+            {lead["identity"], standby["identity"]}, sts_creates
+        self.api.delete("Notebook", "failover", NS)
+        return {"takeover_ms": takeover_ms,
+                "takeover_bound_ms": round(bound_ms, 1),
+                "lease_duration_ms": round(1e3 * el.lease_duration_s),
+                "old_leader": lead["identity"],
+                "new_leader": standby["identity"],
+                "dead_writes_after_takeover": 0}
+
     def delete_cascade(self):
         self.api.delete("Notebook", "walk", NS)
         gone = [("StatefulSet", "walk"), ("Service", "walk"),
@@ -386,13 +469,20 @@ class Walk:
         self.run("conversion", self.conversion,
                  skip=None if self.rest_url else
                  "needs the multi-version REST facade URL")
+        self.run("ha_failover", self.ha_failover,
+                 skip=None if self.ha else
+                 "needs the two-manager local backend")
         self.run("delete_cascade", self.delete_cascade)
         return self.results
 
 
 def local_backend(stop):
     """The wallclock process layout (spawn_conformance's, plus fast
-    culling and the null probe — fake pods serve no Jupyter API)."""
+    culling and the null probe — fake pods serve no Jupyter API) —
+    with the manager deployed the way manifests.py ships it: TWO
+    replicas behind lease-based leader election, each with its own
+    client identity, watch threads and stop event so one can be
+    crashed independently (the ha_failover scenario)."""
     import threading
 
     from kubeflow_rm_tpu.controlplane import (
@@ -407,6 +497,7 @@ def local_backend(stop):
         KubeAPIServer,
     )
     from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+    from kubeflow_rm_tpu.controlplane.ha.leases import LeaderElector
     from kubeflow_rm_tpu.controlplane.runtime import Manager
     from kubeflow_rm_tpu.controlplane.webhook.notebook import (
         NotebookWebhook,
@@ -435,25 +526,49 @@ def local_backend(stop):
     rest.start()
     threading.Thread(target=kubelet.run_forever, args=(stop, 0.05),
                      daemon=True).start()
+    # the Lease namespace (deployment-wise: the manager's own ns)
+    capi.ensure_namespace("kubeflow")
 
-    kapi = KubeAPIServer(rest.url)
-    mgr = make_cluster_manager(
-        kapi,
-        culler_config={
-            # idle after ~1.8s of no activity, checked every ~0.6s;
-            # the null probe models fake pods with no Jupyter API
-            "cull_idle_minutes": 0.03,
-            "check_period_minutes": 0.01,
-            "probe_fn": lambda nb, pod0: None,
-        })
+    culler_config = {
+        # idle after ~1.8s of no activity, checked every ~0.6s;
+        # the null probe models fake pods with no Jupyter API
+        "cull_idle_minutes": 0.03,
+        "check_period_minutes": 0.01,
+        "probe_fn": lambda nb, pod0: None,
+    }
+
+    def elected_manager(identity: str) -> dict:
+        mstop = threading.Event()
+        kapi = KubeAPIServer(rest.url, identity=identity)
+        mgr = make_cluster_manager(kapi, culler_config=culler_config)
+        elector = LeaderElector(
+            kapi, identity,
+            # scaled-down from the 15s/10s/2s production defaults so
+            # the walk's failover completes in seconds; crash-oriented
+            # (release_on_exit stays False)
+            lease_duration_s=1.5, renew_deadline_s=0.5,
+            retry_period_s=0.1)
+        for kind in WATCHED_KINDS:
+            threading.Thread(target=kapi.watch_kind,
+                             args=(kind, None, mstop, 60),
+                             daemon=True).start()
+        mgr.enqueue_all()
+        threading.Thread(target=mgr.run_forever, args=(mstop, 0.05),
+                         kwargs={"workers": 8, "elector": elector},
+                         daemon=True).start()
+        return {"identity": identity, "stop": mstop,
+                "elector": elector, "kapi": kapi}
+
+    managers = [elected_manager("mgr-a"), elected_manager("mgr-b")]
+
+    # the walk reads through its own client so its informer caches
+    # survive a leader kill
+    kapi = KubeAPIServer(rest.url, identity="e2e-client")
     for kind in WATCHED_KINDS:
         threading.Thread(target=kapi.watch_kind,
                          args=(kind, None, stop, 60),
                          daemon=True).start()
-    mgr.enqueue_all()
-    threading.Thread(target=mgr.run_forever, args=(stop, 0.05),
-                     kwargs={"workers": 8}, daemon=True).start()
-    return kapi, rest
+    return kapi, rest, {"capi": capi, "managers": managers}
 
 
 def main() -> int:
@@ -468,17 +583,24 @@ def main() -> int:
                     help="notebook container image (cluster backend: "
                          "something the nodes can pull, e.g. "
                          "busybox:stable)")
+    ap.add_argument("--scenarios", default="",
+                    help="comma-separated subset to run (others are "
+                         "recorded as skipped); scenarios share state "
+                         "— pick prefixes of the full walk order")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
 
     import threading
     stop = threading.Event()
+    only = set(filter(None, args.scenarios.split(","))) or None
     t0 = time.time()
+    ha = None
     if args.backend == "local":
-        api, rest = local_backend(stop)
+        api, rest, ha = local_backend(stop)
         walk = Walk(api, has_fake_kubelet=True, fast_culling=True,
                     rest_url=rest.url,
-                    image=args.image or "jupyter-jax:latest")
+                    image=args.image or "jupyter-jax:latest",
+                    ha=ha, only=only)
     else:
         from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
             KubeAPIServer,
@@ -486,11 +608,13 @@ def main() -> int:
         api = KubeAPIServer(args.server, token=args.token)
         walk = Walk(api, has_fake_kubelet=False, fast_culling=False,
                     rest_url=args.server,
-                    image=args.image or "busybox:stable")
+                    image=args.image or "busybox:stable", only=only)
 
     print(f"e2e walk ({args.backend}):", flush=True)
     results = walk.walk()
     stop.set()
+    for m in (ha or {}).get("managers", []):
+        m["stop"].set()
     ran = [r for r in results if r.get("ok") is not None]
     passed = [r for r in ran if r["ok"]]
     artifact = {

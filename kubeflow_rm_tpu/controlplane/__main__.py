@@ -22,6 +22,13 @@ autodetection; ``ENABLE_CULLING``, ``CULL_IDLE_TIME``,
 ``IDLENESS_CHECK_PERIOD`` gate the culler; ``PORT`` overrides each
 server's default port; ``WEBHOOK_TLS_CERT``/``WEBHOOK_TLS_KEY`` for the
 admission server; ``DISABLE_AUTH=true`` for dev (reference ``DEV``).
+HA/throughput knobs (reference --leader-elect/--qps/--burst,
+notebook-controller/main.go:60-93): ``LEADER_ELECT=true`` gates
+reconciling on a coordination.k8s.io Lease (``LEASE_NAMESPACE``,
+``LEASE_DURATION``, ``LEASE_RENEW_DEADLINE``, ``LEASE_RETRY_PERIOD``);
+``KUBE_CLIENT_QPS``/``KUBE_CLIENT_BURST`` throttle the kube client;
+``RECONCILE_WORKERS`` sets reconcile parallelism; ``POD_NAME`` names
+this replica's election identity.
 """
 
 from __future__ import annotations
@@ -38,12 +45,25 @@ def _env_flag(name: str, default: bool = False) -> bool:
     return default if v is None else v.lower() in ("1", "true", "yes")
 
 
-def _kube_api():
+def _identity() -> str:
+    """This replica's election identity: pod name in-cluster, else
+    hostname+pid (unique per process, stable for its lifetime)."""
+    import socket
+    return os.environ.get("POD_NAME") or \
+        f"{socket.gethostname()}_{os.getpid()}"
+
+
+def _kube_api(identity: str | None = None):
     from kubeflow_rm_tpu.controlplane.deploy.kubeclient import KubeAPIServer
+    qps = os.environ.get("KUBE_CLIENT_QPS")
+    burst = os.environ.get("KUBE_CLIENT_BURST")
     return KubeAPIServer(
         base_url=os.environ.get("KUBE_API_URL"),
         token=os.environ.get("KUBE_TOKEN"),
         ca_cert=os.environ.get("KUBE_CA_CERT", True),
+        qps=float(qps) if qps else None,
+        burst=int(burst) if burst else None,
+        identity=identity,
     )
 
 
@@ -69,7 +89,8 @@ def cmd_controller_manager() -> int:
         WATCHED_KINDS,
         make_cluster_manager,
     )
-    api = _kube_api()
+    identity = _identity()
+    api = _kube_api(identity=identity)
     culler = {}
     if os.environ.get("CULL_IDLE_TIME"):  # minutes, reference name
         culler["cull_idle_minutes"] = float(os.environ["CULL_IDLE_TIME"])
@@ -79,6 +100,19 @@ def cmd_controller_manager() -> int:
     manager = make_cluster_manager(
         api, enable_culling=_env_flag("ENABLE_CULLING"),
         culler_config=culler or None)
+    elector = None
+    if _env_flag("LEADER_ELECT"):
+        from kubeflow_rm_tpu.controlplane.ha.leases import LeaderElector
+        elector = LeaderElector(
+            api, identity,
+            namespace=os.environ.get("LEASE_NAMESPACE", "kubeflow"),
+            lease_duration_s=float(
+                os.environ.get("LEASE_DURATION", "15")),
+            renew_deadline_s=float(
+                os.environ.get("LEASE_RENEW_DEADLINE", "10")),
+            retry_period_s=float(
+                os.environ.get("LEASE_RETRY_PERIOD", "2")),
+            release_on_exit=True)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *a: stop.set())
@@ -91,9 +125,12 @@ def cmd_controller_manager() -> int:
         t.start()
     manager.enqueue_all()
     logging.getLogger("kubeflow_rm_tpu").info(
-        "controller manager running (%d controllers, %d watches)",
-        len(manager.controllers), len(threads))
-    manager.run_forever(stop)
+        "controller manager %s running (%d controllers, %d watches, "
+        "leader_elect=%s)", identity, len(manager.controllers),
+        len(threads), elector is not None)
+    manager.run_forever(
+        stop, workers=int(os.environ.get("RECONCILE_WORKERS", "1")),
+        elector=elector)
     return 0
 
 

@@ -5,9 +5,9 @@ The reference serves ``kubeflow.org/{v1alpha1,v1beta1,v1} Notebook``
 with conversion shims between structurally-identical types
 (``notebook-controller/api/v1beta1/notebook_types.go:27-34``,
 ``api/v1/notebook_conversion.go:1-30`` — v1beta1 is the storage "hub",
-the others convert through it). This framework keeps two served
-versions with a REAL schema delta, because the TPU block is the field
-that actually evolved here:
+the others convert through it). This framework serves three versions
+with REAL schema deltas, because the TPU block is the field that
+actually evolved here:
 
 - ``v1`` (storage/hub): first-class ``spec.tpu {acceleratorType,
   numSlices}`` — what every controller in this repo consumes.
@@ -16,11 +16,15 @@ that actually evolved here:
   type`` / ``tpu-num-slices`` annotations (the same strings the
   controller stamps on pods, so reference-era tooling already knows
   them).
+- ``v1alpha1`` (served): the oldest shape — annotation-carried like
+  v1beta1 but under the bare ``kubeflow.org/tpu-*`` keys that predate
+  the ``notebooks.`` prefix convention.
 
-Conversion is lossless both ways: v1beta1→v1 hoists the annotations
-into ``spec.tpu``; v1→v1beta1 demotes ``spec.tpu`` into the
-annotations. Everything else (the embedded PodSpec, status, behavior
-annotations) is version-invariant, exactly as in the reference.
+Conversion is lossless in every direction: spoke→v1 hoists the
+annotations into ``spec.tpu``; v1→spoke demotes ``spec.tpu`` into
+that spoke's annotation keys. Everything else (the embedded PodSpec,
+status, behavior annotations) is version-invariant, exactly as in the
+reference.
 
 Served by two paths that must agree (tests assert both):
 
@@ -39,13 +43,20 @@ from kubeflow_rm_tpu.controlplane.api.meta import fast_deepcopy
 
 GROUP = "kubeflow.org"
 STORAGE_VERSION = "v1"
-SERVED_VERSIONS = ("v1beta1", "v1")
+SERVED_VERSIONS = ("v1alpha1", "v1beta1", "v1")
 
 #: v1beta1 carries the TPU block as annotations (not labels — these
 #: describe the CR itself; the controller separately stamps pod LABELS
 #: with the same suffixes for the webhook to read)
 TPU_ACCELERATOR_ANNOTATION = "notebooks.kubeflow.org/tpu-accelerator-type"
 TPU_NUM_SLICES_ANNOTATION = "notebooks.kubeflow.org/tpu-num-slices"
+
+#: v1alpha1 predates the ``notebooks.`` prefix convention: same
+#: annotation-shaped TPU placement under the bare group keys (the
+#: oldest tooling's strings). Structurally identical otherwise — the
+#: reference's v1alpha1 is likewise a rename-era twin of v1beta1.
+LEGACY_TPU_ACCELERATOR_ANNOTATION = "kubeflow.org/tpu-accelerator-type"
+LEGACY_TPU_NUM_SLICES_ANNOTATION = "kubeflow.org/tpu-num-slices"
 
 
 def version_of(obj: dict) -> str:
@@ -66,22 +77,32 @@ def convert_notebook(obj: dict, to_version: str) -> dict:
     if cur not in SERVED_VERSIONS:
         raise ValueError(f"cannot convert from unknown version {cur!r}")
     out = fast_deepcopy(obj)
-    if cur == "v1beta1":
-        out = _v1beta1_to_hub(out)
-    if to_version == "v1beta1":
-        out = _hub_to_v1beta1(out)
+    if cur != STORAGE_VERSION:
+        out = _annotations_to_hub(out, *_TPU_KEYS[cur])
+    if to_version != STORAGE_VERSION:
+        out = _hub_to_annotations(out, *_TPU_KEYS[to_version])
     out["apiVersion"] = f"{GROUP}/{to_version}"
     return out
 
 
-def _v1beta1_to_hub(obj: dict) -> dict:
+#: spoke version -> (accelerator key, num-slices key): both pre-hub
+#: shapes are annotation-carried, they just disagree on key names
+_TPU_KEYS = {
+    "v1beta1": (TPU_ACCELERATOR_ANNOTATION, TPU_NUM_SLICES_ANNOTATION),
+    "v1alpha1": (LEGACY_TPU_ACCELERATOR_ANNOTATION,
+                 LEGACY_TPU_NUM_SLICES_ANNOTATION),
+}
+
+
+def _annotations_to_hub(obj: dict, acc_key: str,
+                        slices_key: str) -> dict:
     """Hoist the TPU annotations into first-class ``spec.tpu``. An
     object that (illegally) carries both keeps ``spec.tpu`` — the
     structured field is authoritative."""
     ann = (obj.get("metadata") or {}).get("annotations") or {}
     spec = obj.setdefault("spec", {})
-    acc = ann.pop(TPU_ACCELERATOR_ANNOTATION, None)
-    raw_slices = ann.pop(TPU_NUM_SLICES_ANNOTATION, None)
+    acc = ann.pop(acc_key, None)
+    raw_slices = ann.pop(slices_key, None)
     if acc and "tpu" not in spec:
         tpu: dict = {"acceleratorType": acc}
         if raw_slices is not None:
@@ -89,7 +110,7 @@ def _v1beta1_to_hub(obj: dict) -> dict:
                 n = int(raw_slices)
             except ValueError as e:
                 raise ValueError(
-                    f"{TPU_NUM_SLICES_ANNOTATION}={raw_slices!r} is "
+                    f"{slices_key}={raw_slices!r} is "
                     "not an integer") from e
             if n != 1:
                 tpu["numSlices"] = n
@@ -101,18 +122,19 @@ def _v1beta1_to_hub(obj: dict) -> dict:
     return obj
 
 
-def _hub_to_v1beta1(obj: dict) -> dict:
-    """Demote ``spec.tpu`` into the annotations the reference-era
-    shape uses."""
+def _hub_to_annotations(obj: dict, acc_key: str,
+                        slices_key: str) -> dict:
+    """Demote ``spec.tpu`` into the annotations the pre-hub shapes
+    use."""
     spec = obj.get("spec") or {}
     tpu = spec.pop("tpu", None)
     if tpu:
         ann = obj.setdefault("metadata", {}).setdefault(
             "annotations", {})
-        ann[TPU_ACCELERATOR_ANNOTATION] = tpu["acceleratorType"]
+        ann[acc_key] = tpu["acceleratorType"]
         n = int(tpu.get("numSlices", 1))
         if n != 1:
-            ann[TPU_NUM_SLICES_ANNOTATION] = str(n)
+            ann[slices_key] = str(n)
     return obj
 
 

@@ -15,12 +15,14 @@ injected (``clock``) so culling/idleness tests are deterministic.
 
 from __future__ import annotations
 
+import collections
 import copy
 import datetime
 import fnmatch
 import functools
 import json
 import threading
+import time
 from typing import Callable
 
 from kubeflow_rm_tpu.controlplane.api.meta import (
@@ -108,6 +110,14 @@ class APIServer:
         # kubelet appends boot lines, the `pods/<name>/log` subresource
         # reads them — ref jupyter backend get_pod_logs)
         self._pod_logs: dict[tuple[str, str], list[str]] = {}
+        # bounded audit trail of writes, tagged with the writer identity
+        # set via set_writer (the REST facade stamps it from the
+        # X-Writer-Identity header). The failover conformance asserts
+        # "no overlapping reconciles" over this: once a standby's first
+        # write lands, the dead leader must never write again.
+        self.write_log: collections.deque = collections.deque(maxlen=8192)
+        self._write_seq = 0
+        self._writer = threading.local()
 
     # ---- wiring ------------------------------------------------------
     def register_admission(self, kind_pattern: str, fn: Callable) -> None:
@@ -130,6 +140,25 @@ class APIServer:
     def _next_rv(self) -> str:
         self._rv += 1
         return str(self._rv)
+
+    def set_writer(self, identity: str | None) -> None:
+        """Tag subsequent writes from THIS thread with ``identity`` in
+        the write log (thread-local: the REST facade serves each
+        request on its own thread)."""
+        self._writer.identity = identity
+
+    def _log_write(self, verb: str, obj: dict) -> None:
+        self._write_seq += 1
+        self.write_log.append({
+            "seq": self._write_seq,
+            "rv": int(obj["metadata"].get("resourceVersion") or 0),
+            "verb": verb,
+            "kind": obj["kind"],
+            "namespace": namespace_of(obj),
+            "name": name_of(obj),
+            "writer": getattr(self._writer, "identity", None),
+            "t": time.time(),
+        })
 
     def _emit(self, event: str, obj: dict, old: dict | None = None) -> None:
         # ONE defensive copy shared by all watchers — the watcher
@@ -187,6 +216,7 @@ class APIServer:
         meta["resourceVersion"] = self._next_rv()
         meta["creationTimestamp"] = self.clock().isoformat()
         self._store[key] = obj
+        self._log_write("CREATE", obj)
         self._emit("ADDED", obj)
         return _fastcopy(obj)
 
@@ -264,6 +294,7 @@ class APIServer:
                 old["metadata"]["deletionTimestamp"]
         obj["metadata"]["resourceVersion"] = self._next_rv()
         self._store[key] = obj
+        self._log_write("UPDATE", obj)
         # a deleting object whose finalizers have all been removed goes away
         if obj["metadata"].get("deletionTimestamp") and \
                 not obj["metadata"].get("finalizers"):
@@ -297,6 +328,7 @@ class APIServer:
             if not obj["metadata"].get("deletionTimestamp"):
                 obj["metadata"]["deletionTimestamp"] = self.clock().isoformat()
                 obj["metadata"]["resourceVersion"] = self._next_rv()
+                self._log_write("UPDATE", obj)
                 self._emit("MODIFIED", obj)
             return
         self._finalize_delete(key)
@@ -321,6 +353,7 @@ class APIServer:
 
     def _finalize_delete(self, key) -> dict:
         obj = self._store.pop(key)
+        self._log_write("DELETE", obj)
         if obj["kind"] == "Pod":
             self._pod_logs.pop(
                 (namespace_of(obj) or "default", name_of(obj)), None)

@@ -136,8 +136,18 @@ def notebook_crd() -> dict:
          "jsonPath": ".metadata.annotations['notebooks\\.kubeflow\\."
                      "org/tpu-accelerator-type']"},
     ] + cols[1:]
+    # v1alpha1: same annotation-carried shape under the pre-prefix
+    # ``kubeflow.org/tpu-*`` keys (api/conversion.py LEGACY_*)
+    alpha_schema = _copy.deepcopy(beta_schema)
+    alpha_cols = [
+        {"name": "Accelerator", "type": "string",
+         "jsonPath": ".metadata.annotations['kubeflow\\.org/"
+                     "tpu-accelerator-type']"},
+    ] + cols[1:]
     crd = _crd("kubeflow.org", "Notebook", "notebooks",
-               [_version("v1beta1", beta_schema, storage=False,
+               [_version("v1alpha1", alpha_schema, storage=False,
+                         printer_columns=alpha_cols),
+                _version("v1beta1", beta_schema, storage=False,
                          printer_columns=beta_cols),
                 _version("v1", schema, printer_columns=cols)],
                short_names=["nb"], categories=["kubeflow"])

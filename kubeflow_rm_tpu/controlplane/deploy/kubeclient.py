@@ -72,6 +72,8 @@ RESOURCES: dict[str, tuple[str, str, bool]] = {
     # apps/v1
     "StatefulSet": ("apis/apps/v1", "statefulsets", True),
     "Deployment": ("apis/apps/v1", "deployments", True),
+    # coordination (leader-election Leases, ha/leases.py)
+    "Lease": ("apis/coordination.k8s.io/v1", "leases", True),
     # rbac
     "RoleBinding": ("apis/rbac.authorization.k8s.io/v1",
                     "rolebindings", True),
@@ -99,6 +101,56 @@ RESOURCES: dict[str, tuple[str, str, bool]] = {
         "apis/apiextensions.k8s.io/v1", "customresourcedefinitions",
         False),
 }
+
+
+class TokenBucket:
+    """Client-side qps/burst throttle — client-go's
+    ``flowcontrol.NewTokenBucketRateLimiter`` behind the reference's
+    ``--qps``/``--burst`` flags (notebook-controller/main.go:71-85).
+
+    ``acquire`` debits one token, sleeping when the bucket is dry.
+    Tokens refill at ``qps``; the bucket holds at most ``burst``, so a
+    cold client may send ``burst`` calls back-to-back before the
+    steady-state rate applies. Tokens may go negative (waiters are
+    effectively queued FIFO by their computed wait), which keeps the
+    math lock-cheap and fair enough for a control-plane client.
+
+    Thread-safe and shared across the adapter's per-thread sessions;
+    ``clock``/``sleep`` are injectable for deterministic tests."""
+
+    def __init__(self, qps: float, burst: int | None = None, *,
+                 clock: Callable[[], float] | None = None,
+                 sleep: Callable[[float], None] | None = None):
+        import time as _time
+        if qps <= 0:
+            raise ValueError(f"qps must be > 0, got {qps}")
+        self.qps = float(qps)
+        self.burst = int(burst) if burst else max(1, int(2 * qps))
+        self._clock = clock or _time.monotonic
+        self._sleep = sleep or _time.sleep
+        self._tokens = float(self.burst)
+        self._last = self._clock()
+        self._lock = threading.Lock()
+        # total seconds of wait injected — surfaced for conformance
+        self.throttled_seconds = 0.0
+        self.throttled_calls = 0
+
+    def acquire(self, n: float = 1.0) -> float:
+        """Take ``n`` tokens, sleeping until they are covered. Returns
+        the wait injected (0.0 when the bucket had capacity)."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(float(self.burst),
+                               self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            self._tokens -= n
+            wait = 0.0 if self._tokens >= 0 else -self._tokens / self.qps
+            if wait > 0:
+                self.throttled_seconds += wait
+                self.throttled_calls += 1
+        if wait > 0:
+            self._sleep(wait)
+        return wait
 
 
 class _Resp:
@@ -148,7 +200,8 @@ class _FastSession:
     connection so they don't starve the verb path."""
 
     def __init__(self, base_url: str, token: str | None,
-                 ca_cert: str | bool):
+                 ca_cert: str | bool,
+                 extra_headers: dict[str, str] | None = None):
         import urllib.parse
         u = urllib.parse.urlsplit(base_url)
         self._https = u.scheme == "https"
@@ -157,6 +210,8 @@ class _FastSession:
         self._headers = {"Content-Type": "application/json"}
         if token:
             self._headers["Authorization"] = f"Bearer {token}"
+        if extra_headers:
+            self._headers.update(extra_headers)
         self._ssl_ctx = None
         if self._https:
             import ssl
@@ -268,7 +323,9 @@ class KubeAPIServer:
     def __init__(self, base_url: str | None = None, *,
                  token: str | None = None, ca_cert: str | bool = True,
                  clock: Callable[[], datetime.datetime] | None = None,
-                 session=None, cache_reads: bool = True):
+                 session=None, cache_reads: bool = True,
+                 qps: float | None = None, burst: int | None = None,
+                 identity: str | None = None):
         if base_url is None:
             # in-cluster defaults (KUBERNETES_SERVICE_HOST is set by
             # the kubelet for every pod)
@@ -290,10 +347,20 @@ class KubeAPIServer:
         self._ca_cert = ca_cert
         self._token = token
         self._tls = threading.local()
+        # writer identity: stamped on every request so the facade's
+        # apiserver write log can attribute writes (failover conformance)
+        self.identity = identity
+        # client-side qps/burst throttle, shared across the per-thread
+        # sessions; None = unthrottled (the historical default). Watch
+        # streams and cache-served reads are NOT debited — client-go
+        # likewise exempts watches from the flowcontrol limiter.
+        self.limiter = TokenBucket(qps, burst) if qps else None
         if session is not None:
             session.verify = ca_cert
             if token:
                 session.headers["Authorization"] = f"Bearer {token}"
+            if identity:
+                session.headers["X-Writer-Identity"] = identity
         self.clock = clock or (
             lambda: datetime.datetime.now(datetime.timezone.utc))
         self._watchers: list[Callable[[str, dict, dict | None], None]] = []
@@ -353,9 +420,16 @@ class KubeAPIServer:
             return self._explicit_session
         s = getattr(self._tls, "session", None)
         if s is None:
-            s = _FastSession(self.base_url, self._token, self._ca_cert)
+            extra = {"X-Writer-Identity": self.identity} \
+                if self.identity else None
+            s = _FastSession(self.base_url, self._token, self._ca_cert,
+                             extra_headers=extra)
             self._tls.session = s
         return s
+
+    def _throttle(self) -> None:
+        if self.limiter is not None:
+            self.limiter.acquire()
 
     # ---- wiring (admission/validation are server-side in-cluster) ----
     def register_admission(self, kind_pattern: str, fn: Callable) -> None:
@@ -405,6 +479,7 @@ class KubeAPIServer:
     # ---- verbs (the APIServer contract) ------------------------------
     def create(self, obj: dict) -> dict:
         kind = obj["kind"]
+        self._throttle()
         resp = self._session.post(
             self._collection_url(kind, namespace_of(obj)), json=obj)
         self._raise_for(resp, f"create {kind}/{name_of(obj)}")
@@ -422,6 +497,7 @@ class KubeAPIServer:
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             return fast_deepcopy(obj)
+        self._throttle()
         resp = self._session.get(self._object_url(kind, name, namespace))
         self._raise_for(resp, f"{kind} {namespace}/{name} not found")
         return resp.json()
@@ -450,6 +526,7 @@ class KubeAPIServer:
             ]
             out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
             return out
+        self._throttle()
         resp = self._session.get(
             self._collection_url(kind, namespace),
             params=_selector_param(label_selector))
@@ -461,6 +538,7 @@ class KubeAPIServer:
 
     def update(self, obj: dict) -> dict:
         kind = obj["kind"]
+        self._throttle()
         resp = self._session.put(
             self._object_url(kind, name_of(obj), namespace_of(obj)),
             json=obj)
@@ -472,6 +550,7 @@ class KubeAPIServer:
 
     def patch(self, kind: str, name: str, patch: dict,
               namespace: str | None = None) -> dict:
+        self._throttle()
         resp = self._session.patch(
             self._object_url(kind, name, namespace), json=patch,
             headers={"Content-Type": "application/merge-patch+json"})
@@ -485,6 +564,7 @@ class KubeAPIServer:
         kind = obj["kind"]
         url = self._object_url(kind, name_of(obj), namespace_of(obj)) \
             + "/status"
+        self._throttle()
         resp = self._session.patch(
             url, json={"status": obj.get("status", {})},
             headers={"Content-Type": "application/merge-patch+json"})
@@ -501,6 +581,7 @@ class KubeAPIServer:
 
     def delete(self, kind: str, name: str,
                namespace: str | None = None) -> None:
+        self._throttle()
         resp = self._session.delete(
             self._object_url(kind, name, namespace))
         self._raise_for(resp, f"delete {kind} {namespace}/{name}")
@@ -564,6 +645,7 @@ class KubeAPIServer:
         params = {}
         if tail_lines is not None:
             params["tailLines"] = str(tail_lines)
+        self._throttle()
         resp = self._session.get(
             self._object_url("Pod", pod_name, namespace) + "/log",
             params=params)
@@ -587,6 +669,7 @@ class KubeAPIServer:
                 },
             },
         }
+        self._throttle()
         resp = self._session.post(
             f"{self.base_url}/apis/authorization.k8s.io/v1/"
             "subjectaccessreviews", json=body)

@@ -108,8 +108,17 @@ def controller_manager_objects() -> list[dict]:
         port=8081,
         env=[{"name": "ENABLE_CULLING", "value": "true"},
              {"name": "CULL_IDLE_TIME", "value": "1440"},
-             {"name": "IDLENESS_CHECK_PERIOD", "value": "1"}],
+             {"name": "IDLENESS_CHECK_PERIOD", "value": "1"},
+             # HA pair: both replicas run, the lease decides who
+             # reconciles (controlplane/ha); POD_NAME is the election
+             # identity, qps/burst bound the shared apiserver budget
+             {"name": "LEADER_ELECT", "value": "true"},
+             {"name": "POD_NAME", "valueFrom": {"fieldRef": {
+                 "fieldPath": "metadata.name"}}},
+             {"name": "KUBE_CLIENT_QPS", "value": "20"},
+             {"name": "KUBE_CLIENT_BURST", "value": "40"}],
     )
+    dep["spec"]["replicas"] = 2
     # the manager serves no HTTP; probe is exec-based liveness instead
     c = dep["spec"]["template"]["spec"]["containers"][0]
     del c["readinessProbe"]
@@ -219,6 +228,10 @@ def rbac_objects() -> list[dict]:
          "verbs": ["*"]},
         {"apiGroups": ["authorization.k8s.io"],
          "resources": ["subjectaccessreviews"], "verbs": ["create"]},
+        # leader-election lock for the two-replica manager
+        {"apiGroups": ["coordination.k8s.io"],
+         "resources": ["leases"],
+         "verbs": ["get", "list", "watch", "create", "update"]},
     ]
     role = {"apiVersion": "rbac.authorization.k8s.io/v1",
             "kind": "ClusterRole",

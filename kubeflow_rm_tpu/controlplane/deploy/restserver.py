@@ -159,6 +159,9 @@ class RestServer:
         parsed = urlparse(handler.path)
         params = parse_qs(parsed.query)
         method = handler.command
+        # tag this request thread's writes in the apiserver audit log
+        # (kubeclient sends the header when built with identity=...)
+        self.api.set_writer(handler.headers.get("X-Writer-Identity"))
 
         if parsed.path == "/apis/authorization.k8s.io/v1/subjectaccessreviews" \
                 and method == "POST":

@@ -1,0 +1,27 @@
+"""HA reconcile runtime: leader election + rate-limited work queues.
+
+Two replicas of the controller manager must not double-reconcile. The
+reference gets this from controller-runtime (lease-based leader
+election, ``notebook-controller/main.go:60-93``) and from client-go's
+rate-limited workqueue. This package provides both over the repo's own
+APIServer verb surface:
+
+- ``leases.py``: coordination.k8s.io/v1 Lease objects plus a
+  ``LeaderElector`` implementing acquire/renew/steal with
+  resourceVersion fencing. Only the elected leader's Manager
+  reconciles; standbys keep their informers warm and take over within
+  one lease duration of leader death.
+- ``workqueue.py``: per-controller work queues with dedup on enqueue,
+  per-item exponential backoff with jitter, a max-retries terminal
+  path, and per-controller concurrency caps (MaxConcurrentReconciles).
+"""
+
+from kubeflow_rm_tpu.controlplane.ha.leases import (  # noqa: F401
+    DEFAULT_LEASE_NAME,
+    LeaderElector,
+    make_lease,
+)
+from kubeflow_rm_tpu.controlplane.ha.workqueue import (  # noqa: F401
+    ExponentialBackoff,
+    WorkQueue,
+)

@@ -12,6 +12,7 @@ from prometheus_client import (
     CollectorRegistry,
     Counter,
     Gauge,
+    Histogram,
     generate_latest,
 )
 
@@ -60,6 +61,63 @@ TPU_CHIPS_REQUESTED = Gauge(
     "TPU chips currently requested by scheduled notebook pods",
     registry=REGISTRY,
 )
+
+# ---- HA runtime (controlplane/ha): leader election + workqueues ------
+LEADER_IS_LEADER = Gauge(
+    "leader_is_leader",
+    "1 while this identity holds the controller-manager lease "
+    "(controller-runtime's leader_election_master_status)",
+    ["identity"],
+    registry=REGISTRY,
+)
+WORKQUEUE_DEPTH = Gauge(
+    "workqueue_depth",
+    "Items waiting in a controller's work queue",
+    ["name"],
+    registry=REGISTRY,
+)
+WORKQUEUE_ADDS_TOTAL = Counter(
+    "workqueue_adds_total",
+    "Total items added to a controller's work queue (pre-dedup)",
+    ["name"],
+    registry=REGISTRY,
+)
+WORKQUEUE_REQUEUES_TOTAL = Counter(
+    "workqueue_requeues_total",
+    "Total rate-limited (backoff) requeues per work queue",
+    ["name"],
+    registry=REGISTRY,
+)
+WORKQUEUE_RETRIES_EXHAUSTED_TOTAL = Counter(
+    "workqueue_retries_exhausted_total",
+    "Items dropped after exhausting their retry budget",
+    ["name"],
+    registry=REGISTRY,
+)
+WORKQUEUE_QUEUE_SECONDS = Histogram(
+    "workqueue_queue_duration_seconds",
+    "Time items spend waiting in a work queue before hand-out",
+    ["name"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+    registry=REGISTRY,
+)
+
+
+def registry_value(sample_name: str,
+                   labels: dict[str, str] | None = None) -> float:
+    """Sum the current value of all samples named ``sample_name``
+    (optionally filtered by labels) — how the dashboard's inventory
+    backend reads in-process HA gauges without scraping itself."""
+    total = 0.0
+    for family in REGISTRY.collect():
+        for sample in family.samples:
+            if sample.name != sample_name:
+                continue
+            if labels and any(sample.labels.get(k) != v
+                              for k, v in labels.items()):
+                continue
+            total += sample.value
+    return total
 
 
 def scrape() -> bytes:

@@ -15,7 +15,6 @@ the reference relies on for failure recovery (SURVEY.md §5).
 
 from __future__ import annotations
 
-import datetime
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
@@ -86,30 +85,37 @@ class Manager:
         import threading
         self.api = api
         self.controllers: list[Controller] = []
-        self._queues: dict[str, set[Request]] = {}
-        # guards _queues: in the run_forever deployment the kube
-        # adapter's per-kind watch threads enqueue via _on_event while
-        # the serving thread drains
+        # one rate-limited work queue per controller (ha/workqueue.py):
+        # dedup on enqueue, per-item backoff with jitter, max-retries
+        # terminal path, per-controller concurrency caps
+        self._queues: dict[str, "WorkQueue"] = {}
+        # guards the errors list; each queue carries its own lock
         self._queue_lock = threading.Lock()
-        # (due_time, controller_name, request)
-        self._timed: list[tuple[datetime.datetime, str, Request]] = []
-        self._retries: dict[tuple[str, Request], int] = {}
         self.errors: list[tuple[str, Request, Exception]] = []
         # run_forever blocks on this between drains; enqueue sets it so
         # watch events are served at HTTP latency, not poll latency
         self._wake = threading.Event()
         api.add_watcher(self._on_event)
 
+    def _queue_clock(self) -> float:
+        # queues measure time on the apiserver's injected clock, so
+        # requeue_after and backoff stay deterministic under test clocks
+        return self.api.clock().timestamp()
+
     def add(self, controller: Controller) -> None:
+        from kubeflow_rm_tpu.controlplane.ha.workqueue import WorkQueue
         if not controller.name:
             controller.name = type(controller).__name__
         self.controllers.append(controller)
-        self._queues.setdefault(controller.name, set())
+        self._queues.setdefault(controller.name, WorkQueue(
+            name=controller.name, clock=self._queue_clock,
+            max_retries=self.MAX_RETRIES,
+            max_conflict_retries=self.MAX_CONFLICT_RETRIES,
+            max_concurrent=getattr(controller, "max_concurrent", None)))
 
     def enqueue(self, controller: Controller | str, req: Request) -> None:
         name = controller if isinstance(controller, str) else controller.name
-        with self._queue_lock:
-            self._queues[name].add(req)
+        self._queues[name].add(req)
         self._wake.set()
 
     def enqueue_all(self) -> None:
@@ -129,53 +135,58 @@ class Manager:
                         if req.name:
                             self.enqueue(c, req)
 
-    def _due_timed(self) -> list[tuple[str, Request]]:
-        now = self.api.clock()
-        due = [(n, r) for (t, n, r) in self._timed if t <= now]
-        self._timed = [(t, n, r) for (t, n, r) in self._timed if t > now]
-        return due
-
     def run_until_idle(self, max_iterations: int = 10_000) -> int:
         """Process queues until empty (timed requeues fire only when the
-        injected clock passes them). Returns reconcile count."""
+        injected clock passes them; backoff requeues are promoted
+        immediately — deterministic drains keep the historical
+        immediate-retry semantics). Returns reconcile count."""
         count = 0
         for _ in range(max_iterations):
-            with self._queue_lock:
-                for cname, req in self._due_timed():
-                    self._queues[cname].add(req)
-                pending = [(c, req) for c in self.controllers
-                           for req in sorted(self._queues[c.name])]
-            if not pending:
+            batch = [(c, req) for c in self.controllers
+                     for req in self._queues[c.name].pop_ready(
+                         ignore_backoff=True)]
+            if not batch:
                 return count
-            for c, req in pending:
-                with self._queue_lock:
-                    self._queues[c.name].discard(req)
+            for c, req in batch:
                 count += 1
+                q = self._queues[c.name]
                 try:
                     requeue_after = c.reconcile(self.api, req)
-                    self._retries.pop((c.name, req, False), None)
-                    self._retries.pop((c.name, req, True), None)
+                    q.forget(req)
                     if requeue_after is not None:
-                        due = self.api.clock() + datetime.timedelta(
-                            seconds=requeue_after)
-                        self._timed.append((due, c.name, req))
+                        q.add_after(req, requeue_after)
                 except (Conflict,) as e:
                     self._retry(c, req, e)
                 except NotFound:
                     pass  # object vanished; level-triggered — nothing to do
                 except Exception as e:  # reconcile error: retry w/ backoff
                     self._retry(c, req, e)
-        with self._queue_lock:
-            hot = {c.name: sorted(self._queues[c.name])
-                   for c in self.controllers if self._queues[c.name]}
+                finally:
+                    q.done(req)
+        hot = {c.name: self._queues[c.name].snapshot()
+               for c in self.controllers
+               if self._queues[c.name].depth()}
         raise RuntimeError(
             f"manager did not quiesce in {max_iterations} iterations "
             f"(hot objects: {hot})"
         )
 
+    def _poll_timeout(self, poll_interval_s: float) -> float:
+        """Bound the inter-drain sleep by the earliest delayed item so
+        backoff/timed requeues fire on time, not a poll late."""
+        earliest = None
+        for q in self._queues.values():
+            due = q.next_due()
+            if due is not None and (earliest is None or due < earliest):
+                earliest = due
+        if earliest is None:
+            return poll_interval_s
+        delta = earliest - self._queue_clock()
+        return max(0.001, min(poll_interval_s, delta))
+
     def run_forever(self, stop=None, poll_interval_s: float = 1.0,
                     on_error: Callable | None = None,
-                    workers: int = 1) -> None:
+                    workers: int = 1, elector=None) -> None:
         """In-cluster serving loop: drain the queues whenever watch
         events (fanned into ``_on_event`` by the kube adapter's watch
         threads) or timed requeues produce work; sleep ``poll_interval_s``
@@ -189,11 +200,28 @@ class Manager:
         is a chain of HTTP round-trips, and one serial drain thread
         turns N simultaneous spawns into an N× latency queue — the
         reference exposes --qps/--burst for exactly this path
-        (notebook-controller/main.go:71-85)."""
+        (notebook-controller/main.go:71-85).
+
+        ``elector`` (ha.LeaderElector) gates reconciling on holding the
+        lease: its loop runs on a daemon thread, watch events keep
+        accumulating in the (deduped) queues while standing by, and on
+        promotion the queues are resynced with ``enqueue_all`` — so a
+        standby takes over within one lease duration with a warm cache
+        and a complete work list."""
         import logging
         import threading
         stop = stop or threading.Event()
         logger = logging.getLogger("kubeflow_rm_tpu.manager")
+
+        if elector is not None:
+            def _on_promoted():
+                self.enqueue_all()
+                self._wake.set()
+            elector.on_started_leading.append(_on_promoted)
+            elector.on_stopped_leading.append(self._wake.set)
+            threading.Thread(
+                target=elector.run, args=(stop,), daemon=True,
+                name=f"leader-elect-{elector.identity}").start()
 
         def report_errors():
             with self._queue_lock:
@@ -208,64 +236,71 @@ class Manager:
         if workers <= 1:
             while not stop.is_set():
                 self._wake.clear()
+                if elector is not None and not elector.is_leader:
+                    report_errors()
+                    self._wake.wait(poll_interval_s)
+                    continue
                 try:
-                    self.run_until_idle()
+                    self._drain_serial(stop, elector)
                 except RuntimeError as e:
                     logger.error("manager drain failed: %s", e)
                 report_errors()
                 # woken immediately by enqueue; the timeout only bounds
-                # how late a timed requeue (or stop) can fire
-                self._wake.wait(poll_interval_s)
+                # how late a timed/backoff requeue (or stop) can fire
+                self._wake.wait(self._poll_timeout(poll_interval_s))
             return
 
         from concurrent.futures import ThreadPoolExecutor
 
-        inflight: set[tuple[str, Request]] = set()  # guarded by _queue_lock
         with ThreadPoolExecutor(max_workers=workers,
                                 thread_name_prefix="reconcile") as pool:
             while not stop.is_set():
                 self._wake.clear()
+                if elector is not None and not elector.is_leader:
+                    report_errors()
+                    self._wake.wait(poll_interval_s)
+                    continue
                 # brief dwell so an event burst (pod ADDED + MODIFIED +
                 # STS MODIFIED from one spawn) coalesces into ONE
                 # reconcile per key instead of one per event — the
                 # work-queue rate limiter's job in controller-runtime
                 if stop.wait(0.01):
                     break
-                submitted = []
-                with self._queue_lock:
-                    for cname, req in self._due_timed():
-                        self._queues[cname].add(req)
-                    for c in self.controllers:
-                        for req in sorted(self._queues[c.name]):
-                            key = (c.name, req)
-                            if key in inflight:
-                                # re-enqueued while reconciling: stays
-                                # queued; the worker's finish wakes us
-                                continue
-                            self._queues[c.name].discard(req)
-                            inflight.add(key)
-                            submitted.append((c, req))
-                for c, req in submitted:
-                    pool.submit(self._reconcile_one, c, req, inflight)
+                for c in self.controllers:
+                    for req in self._queues[c.name].pop_ready():
+                        pool.submit(self._reconcile_one, c, req)
                 report_errors()
-                self._wake.wait(poll_interval_s)
+                self._wake.wait(self._poll_timeout(poll_interval_s))
 
-    def _reconcile_one(self, c: Controller, req: Request,
-                       inflight: set) -> None:
-        """One worker-pool reconcile with the serial loop's
-        retry/requeue semantics."""
+    def _drain_serial(self, stop, elector) -> int:
+        """Serial run_forever drain: like run_until_idle but honoring
+        backoff delays (real time passes between drains) and bailing
+        out on stop/demotion."""
+        count = 0
+        for _ in range(10_000):
+            if stop.is_set() or \
+                    (elector is not None and not elector.is_leader):
+                return count
+            batch = [(c, req) for c in self.controllers
+                     for req in self._queues[c.name].pop_ready()]
+            if not batch:
+                return count
+            for c, req in batch:
+                count += 1
+                self._reconcile_one(c, req)
+        raise RuntimeError("manager did not quiesce in 10000 iterations")
+
+    def _reconcile_one(self, c: Controller, req: Request) -> None:
+        """One reconcile with retry/requeue semantics (both the serial
+        drain and the worker pool land here)."""
         import logging
+        q = self._queues[c.name]
         try:
             try:
                 requeue_after = c.reconcile(self.api, req)
-                with self._queue_lock:
-                    self._retries.pop((c.name, req, False), None)
-                    self._retries.pop((c.name, req, True), None)
+                q.forget(req)
                 if requeue_after is not None:
-                    due = self.api.clock() + datetime.timedelta(
-                        seconds=requeue_after)
-                    with self._queue_lock:
-                        self._timed.append((due, c.name, req))
+                    q.add_after(req, requeue_after)
             except Conflict as e:
                 self._retry(c, req, e)
             except NotFound:
@@ -275,29 +310,21 @@ class Manager:
                     "%s %s: %s", c.name, req, e)
                 self._retry(c, req, e)
         finally:
-            with self._queue_lock:
-                inflight.discard((c.name, req))
-            # the key may have been re-enqueued mid-flight: wake the
-            # dispatcher so it gets picked up at HTTP latency
+            # the key may have been re-enqueued mid-flight: the queue
+            # returns it to pending; wake the dispatcher so it gets
+            # picked up at HTTP latency
+            q.done(req)
             self._wake.set()
 
     def _retry(self, c: Controller, req: Request, e: Exception) -> None:
         from kubeflow_rm_tpu.controlplane import metrics
         metrics.RECONCILE_ERRORS_TOTAL.labels(controller=c.name).inc()
         conflict = isinstance(e, Conflict)
-        cap = self.MAX_CONFLICT_RETRIES if conflict else self.MAX_RETRIES
-        # conflicts and real errors keep SEPARATE counters: a key that
-        # absorbed many (expected) conflict retries must still get the
-        # full error budget for its first genuine failure
-        k = (c.name, req, conflict)
-        with self._queue_lock:
-            n = self._retries.get(k, 0) + 1
-            self._retries[k] = n
-            give_up = n > cap
-            if give_up:
+        if self._queues[c.name].add_rate_limited(req, conflict=conflict):
+            self._wake.set()
+        else:
+            with self._queue_lock:
                 self.errors.append((c.name, req, e))
-        if not give_up:
-            self.enqueue(c, req)
 
 
 def rwo_mounting_node(api: APIServer, namespace: str,

@@ -36,6 +36,36 @@ class MetricsService(Protocol):
     def snapshot(self) -> dict: ...
 
 
+def _controlplane_section(api=None) -> dict:
+    """HA runtime health for the dashboard pills: who holds the
+    controller-manager lease (from the store) plus the in-process
+    workqueue/leadership gauges (``controlplane/metrics.py``)."""
+    from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+    leader, transitions = None, None
+    if api is not None:
+        try:
+            from kubeflow_rm_tpu.controlplane.ha.leases import (
+                DEFAULT_LEASE_NAME,
+            )
+            lease = api.try_get("Lease", DEFAULT_LEASE_NAME, "kubeflow")
+        except Exception:  # noqa: BLE001 - lease kind may not exist
+            lease = None
+        if lease:
+            spec = lease.get("spec") or {}
+            leader = spec.get("holderIdentity") or None
+            transitions = spec.get("leaseTransitions")
+    return {
+        "leader": leader,
+        "lease_transitions": transitions,
+        "is_leader": cp_metrics.registry_value("leader_is_leader"),
+        "workqueue_depth": cp_metrics.registry_value("workqueue_depth"),
+        "workqueue_requeues": cp_metrics.registry_value(
+            "workqueue_requeues_total"),
+        "retries_exhausted": cp_metrics.registry_value(
+            "workqueue_retries_exhausted_total"),
+    }
+
+
 class InventoryMetricsService:
     """Fleet numbers from the store: per-accelerator-type chip
     allocatable/used plus the summary counters the SPA pills show."""
@@ -90,6 +120,7 @@ class InventoryMetricsService:
                                        for e in per_type.values()),
                 "notebooks_running": running,
             },
+            "controlplane": _controlplane_section(api),
         }
 
 
@@ -140,6 +171,15 @@ class PrometheusMetricsService:
                 "chips_capacity": None,
                 "chips_requested": g.get("tpu_chips_requested"),
                 "notebooks_running": g.get("notebook_running"),
+            },
+            "controlplane": {
+                "leader": None,  # identity label lost in the flat sum
+                "lease_transitions": None,
+                "is_leader": g.get("leader_is_leader"),
+                "workqueue_depth": g.get("workqueue_depth"),
+                "workqueue_requeues": g.get("workqueue_requeues_total"),
+                "retries_exhausted": g.get(
+                    "workqueue_retries_exhausted_total"),
             },
         }
 

@@ -17,6 +17,53 @@ import contextlib
 import cProfile
 import io
 import pstats
+import time
+
+
+class PhaseRecorder:
+    """Named wall-clock phases of a repeated operation, aggregated into
+    per-phase percentiles — the conformance harness's breakdown of
+    where provision latency goes (POST→CR, CR→StatefulSet,
+    StatefulSet→Pods, Pods→Ready).
+
+    ``record(phase, seconds)`` takes externally-measured durations
+    (e.g. computed from apiserver write-log timestamps); ``phase(name)``
+    times a block inline. ``summary()`` returns per-phase
+    count/p50/p95/max in milliseconds."""
+
+    def __init__(self):
+        self._samples: dict[str, list[float]] = {}
+
+    def record(self, phase: str, seconds: float) -> None:
+        self._samples.setdefault(phase, []).append(float(seconds))
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0)
+
+    def merge(self, other: "PhaseRecorder") -> None:
+        for name, vals in other._samples.items():
+            self._samples.setdefault(name, []).extend(vals)
+
+    @staticmethod
+    def _pct(vals: list[float], q: float) -> float:
+        i = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+        return sorted(vals)[i]
+
+    def summary(self) -> dict[str, dict]:
+        out = {}
+        for name, vals in self._samples.items():
+            out[name] = {
+                "count": len(vals),
+                "p50_ms": round(self._pct(vals, 0.5) * 1e3, 1),
+                "p95_ms": round(self._pct(vals, 0.95) * 1e3, 1),
+                "max_ms": round(max(vals) * 1e3, 1),
+            }
+        return out
 
 
 @contextlib.contextmanager

@@ -247,3 +247,110 @@ def test_notebook_crd_declares_both_versions_and_conversion():
     assert conv["strategy"] == "Webhook"
     assert conv["webhook"]["clientConfig"]["service"]["path"] == \
         "/convert"
+
+
+# ---- v1alpha1: the pre-prefix annotation shape -----------------------
+
+def test_v1_to_v1alpha1_uses_legacy_annotation_keys():
+    from kubeflow_rm_tpu.controlplane.api.conversion import (
+        LEGACY_TPU_ACCELERATOR_ANNOTATION,
+        LEGACY_TPU_NUM_SLICES_ANNOTATION,
+    )
+
+    alpha = convert_notebook(_v1_nb(), "v1alpha1")
+    assert alpha["apiVersion"] == "kubeflow.org/v1alpha1"
+    assert "tpu" not in alpha["spec"]
+    ann = alpha["metadata"]["annotations"]
+    assert ann[LEGACY_TPU_ACCELERATOR_ANNOTATION] == "v5p-16"
+    assert ann[LEGACY_TPU_NUM_SLICES_ANNOTATION] == "2"
+    # the new-style keys are NOT stamped on the alpha shape
+    assert TPU_ACCELERATOR_ANNOTATION not in ann
+
+
+def test_v1alpha1_round_trips_through_hub():
+    nb = _v1_nb(annotations={"user-note": "keep me"})
+    alpha = convert_notebook(nb, "v1alpha1")
+    assert convert_notebook(alpha, "v1") == nb
+    # spoke-to-spoke goes through the hub: alpha -> beta renames keys
+    beta = convert_notebook(alpha, "v1beta1")
+    ann = beta["metadata"]["annotations"]
+    assert ann[TPU_ACCELERATOR_ANNOTATION] == "v5p-16"
+    assert ann["user-note"] == "keep me"
+    from kubeflow_rm_tpu.controlplane.api.conversion import (
+        LEGACY_TPU_ACCELERATOR_ANNOTATION,
+    )
+    assert LEGACY_TPU_ACCELERATOR_ANNOTATION not in ann
+    assert convert_notebook(beta, "v1alpha1") == alpha
+
+
+def test_conversion_review_serves_v1alpha1():
+    review = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "ConversionReview",
+        "request": {
+            "uid": "u-alpha",
+            "desiredAPIVersion": "kubeflow.org/v1alpha1",
+            "objects": [_v1_nb()],
+        },
+    }
+    out = convert_review(review)
+    obj = out["response"]["convertedObjects"][0]
+    assert obj["apiVersion"] == "kubeflow.org/v1alpha1"
+    assert "tpu" not in obj["spec"]
+
+
+def test_rest_facade_serves_v1alpha1_over_one_store():
+    """Create through the oldest API path; the stored hub object and
+    the v1 view both carry first-class spec.tpu."""
+    from kubeflow_rm_tpu.controlplane.api.conversion import (
+        LEGACY_TPU_ACCELERATOR_ANNOTATION,
+        LEGACY_TPU_NUM_SLICES_ANNOTATION,
+    )
+    from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+    )
+    from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+
+    capi = APIServer()
+    capi.ensure_namespace("ns")
+    rest = RestServer(capi)
+    rest.start()
+    try:
+        sess = KubeAPIServer(rest.url)._session
+        alpha_obj = {
+            "apiVersion": "kubeflow.org/v1alpha1",
+            "kind": "Notebook",
+            "metadata": {
+                "name": "ancient", "namespace": "ns",
+                "annotations": {
+                    LEGACY_TPU_ACCELERATOR_ANNOTATION: "v5p-16",
+                    LEGACY_TPU_NUM_SLICES_ANNOTATION: "2",
+                },
+            },
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "ancient", "image": "jupyter-jax:latest"}]}}},
+        }
+        resp = sess.post(
+            f"{rest.url}/apis/kubeflow.org/v1alpha1/namespaces/ns/"
+            "notebooks", json=alpha_obj)
+        assert resp.status_code == 201, resp.text
+        assert resp.json()["apiVersion"] == "kubeflow.org/v1alpha1"
+        stored = capi.get("Notebook", "ancient", "ns")
+        assert stored["spec"]["tpu"] == {"acceleratorType": "v5p-16",
+                                         "numSlices": 2}
+        # every served version reads the same object in its own shape
+        v1 = sess.get(f"{rest.url}/apis/kubeflow.org/v1/namespaces/ns/"
+                      "notebooks/ancient").json()
+        assert v1["spec"]["tpu"]["acceleratorType"] == "v5p-16"
+        beta = sess.get(f"{rest.url}/apis/kubeflow.org/v1beta1/"
+                        "namespaces/ns/notebooks/ancient").json()
+        assert beta["metadata"]["annotations"][
+            TPU_ACCELERATOR_ANNOTATION] == "v5p-16"
+        alpha = sess.get(f"{rest.url}/apis/kubeflow.org/v1alpha1/"
+                         "namespaces/ns/notebooks/ancient").json()
+        assert alpha["metadata"]["annotations"][
+            LEGACY_TPU_ACCELERATOR_ANNOTATION] == "v5p-16"
+        assert "tpu" not in alpha["spec"]
+    finally:
+        rest.stop()

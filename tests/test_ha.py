@@ -1,0 +1,522 @@
+"""HA reconcile runtime: rate-limited work queue, lease-based leader
+election, and the client qps/burst throttle.
+
+Covers the client-go semantics the subsystem mirrors — workqueue
+dedup/processing-dirty/backoff/terminal, leaderelection
+acquire/renew/steal with rv-CAS fencing, flowcontrol token bucket —
+plus a real two-elector failover over the in-memory apiserver."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane, metrics
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer, Conflict
+from kubeflow_rm_tpu.controlplane.deploy.kubeclient import TokenBucket
+from kubeflow_rm_tpu.controlplane.ha import (
+    ExponentialBackoff,
+    LeaderElector,
+    WorkQueue,
+)
+
+from tests.cp_fixtures import FakeClock
+
+
+class ManualClock:
+    """Float-seconds clock for the queue (monotonic stand-in)."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+# ---- ExponentialBackoff ----------------------------------------------
+
+def test_backoff_doubles_and_caps():
+    bo = ExponentialBackoff(base_delay_s=0.01, max_delay_s=0.05,
+                            jitter=0.0)
+    delays = [bo.next_delay("a") for _ in range(5)]
+    assert delays == [0.01, 0.02, 0.04, 0.05, 0.05]
+    assert bo.failures("a") == 5
+    bo.forget("a")
+    assert bo.failures("a") == 0
+    assert bo.next_delay("a") == 0.01
+
+
+def test_backoff_is_per_item():
+    bo = ExponentialBackoff(base_delay_s=0.01, jitter=0.0)
+    bo.next_delay("a")
+    bo.next_delay("a")
+    assert bo.next_delay("b") == 0.01  # b's counter is independent
+    assert bo.failures("a") == 2
+
+
+def test_backoff_jitter_bounded():
+    bo = ExponentialBackoff(base_delay_s=0.01, max_delay_s=10.0,
+                            jitter=0.25, rng=random.Random(7))
+    for _ in range(20):
+        d = bo.next_delay("a")
+        base = min(0.01 * 2 ** (bo.failures("a") - 1), 10.0)
+        assert base <= d <= base * 1.25
+
+
+# ---- WorkQueue -------------------------------------------------------
+
+def test_queue_dedups_adds():
+    q = WorkQueue("t", clock=ManualClock())
+    q.add("x")
+    q.add("x")
+    q.add("y")
+    assert q.depth() == 2
+    assert q.pop_ready() == ["x", "y"]
+    assert q.pop_ready() == []
+
+
+def test_queue_processing_and_dirty():
+    q = WorkQueue("t", clock=ManualClock())
+    q.add("x")
+    assert q.pop_ready() == ["x"]
+    # re-added mid-flight: not handed out again (one reconcile per key)
+    q.add("x")
+    assert q.pop_ready() == []
+    # ...but not lost: done() re-pends it
+    assert q.done("x") is True
+    assert q.pop_ready() == ["x"]
+    assert q.done("x") is False
+
+
+def test_queue_backoff_delays_then_promotes():
+    clk = ManualClock()
+    q = WorkQueue("t", clock=clk,
+                  backoff=ExponentialBackoff(base_delay_s=0.1,
+                                             jitter=0.0))
+    assert q.add_rate_limited("x") is True
+    assert q.pop_ready() == []          # not due yet
+    clk.advance(0.05)
+    assert q.pop_ready() == []
+    clk.advance(0.06)
+    assert q.pop_ready() == ["x"]       # due after base delay
+    q.done("x")
+    # second failure backs off twice as long
+    q.add_rate_limited("x")
+    clk.advance(0.15)
+    assert q.pop_ready() == []
+    clk.advance(0.06)
+    assert q.pop_ready() == ["x"]
+
+
+def test_queue_ignore_backoff_promotes_retries_not_timed_adds():
+    clk = ManualClock()
+    q = WorkQueue("t", clock=clk,
+                  backoff=ExponentialBackoff(base_delay_s=5.0,
+                                             jitter=0.0))
+    q.add_rate_limited("retry")
+    q.add_after("timed", 60.0)
+    # deterministic drain: backoff requeues come back immediately,
+    # requeue_after (culler periods) never do
+    assert q.pop_ready(ignore_backoff=True) == ["retry"]
+    q.done("retry")
+    clk.advance(59.0)
+    assert q.pop_ready(ignore_backoff=True) == []
+    clk.advance(2.0)
+    assert q.pop_ready() == ["timed"]
+
+
+def test_queue_retry_budget_exhaustion_fires_terminal():
+    dropped = []
+    q = WorkQueue("t", clock=ManualClock(), max_retries=3,
+                  on_terminal=dropped.append,
+                  backoff=ExponentialBackoff(jitter=0.0))
+    for _ in range(3):
+        assert q.add_rate_limited("x") is True
+    assert q.add_rate_limited("x") is False
+    assert dropped == ["x"]
+    # counters were reset: the item starts a fresh budget
+    assert q.backoff.failures("x") == 0
+    assert q.add_rate_limited("x") is True
+
+
+def test_queue_conflict_budget_is_separate_and_larger():
+    q = WorkQueue("t", clock=ManualClock(), max_retries=2,
+                  max_conflict_retries=5)
+    for _ in range(5):
+        assert q.add_rate_limited("x", conflict=True) is True
+    assert q.add_rate_limited("x", conflict=True) is False
+    # error budget unaffected by conflict counts
+    assert q.add_rate_limited("y") is True
+    assert q.add_rate_limited("y") is True
+    assert q.add_rate_limited("y") is False
+
+
+def test_queue_max_concurrent_caps_handout():
+    q = WorkQueue("t", clock=ManualClock(), max_concurrent=2)
+    for item in ("a", "b", "c", "d"):
+        q.add(item)
+    assert q.pop_ready() == ["a", "b"]
+    assert q.pop_ready() == []          # both slots busy
+    q.done("a")
+    assert q.pop_ready() == ["c"]
+
+
+def test_queue_metrics_depth_and_requeues():
+    q = WorkQueue("metrics-probe", clock=ManualClock())
+    q.add("a")
+    q.add("b")
+    assert metrics.registry_value(
+        "workqueue_depth", {"name": "metrics-probe"}) == 2.0
+    q.add_rate_limited("a")
+    assert metrics.registry_value(
+        "workqueue_requeues_total", {"name": "metrics-probe"}) >= 1.0
+    q.pop_ready()
+    assert metrics.registry_value(
+        "workqueue_depth", {"name": "metrics-probe"}) == 0.0
+
+
+# ---- LeaderElector ---------------------------------------------------
+
+@pytest.fixture
+def lease_api():
+    clock = FakeClock()
+    api = APIServer(clock=clock)
+    api.ensure_namespace("kubeflow")
+    return api, clock
+
+
+def elector(api, identity, **kw):
+    kw.setdefault("lease_duration_s", 15.0)
+    kw.setdefault("retry_period_s", 2.0)
+    return LeaderElector(api, identity, **kw)
+
+
+def test_elector_acquires_fresh_lease(lease_api):
+    api, clock = lease_api
+    a = elector(api, "mgr-a")
+    assert a.try_acquire_or_renew() is True
+    lease = api.get("Lease", a.lease_name, "kubeflow")
+    assert lease["spec"]["holderIdentity"] == "mgr-a"
+    assert lease["spec"]["leaseDurationSeconds"] == 15
+
+
+def test_elector_renews_own_lease(lease_api):
+    api, clock = lease_api
+    a = elector(api, "mgr-a")
+    a.try_acquire_or_renew()
+    first = api.get("Lease", a.lease_name, "kubeflow")["spec"]["renewTime"]
+    clock.advance(seconds=5)
+    assert a.try_acquire_or_renew() is True
+    renewed = api.get("Lease", a.lease_name,
+                      "kubeflow")["spec"]["renewTime"]
+    assert renewed > first
+
+
+def test_standby_cannot_steal_fresh_lease(lease_api):
+    api, clock = lease_api
+    a, b = elector(api, "mgr-a"), elector(api, "mgr-b")
+    a.try_acquire_or_renew()
+    clock.advance(seconds=10)           # < lease_duration_s
+    assert b.try_acquire_or_renew() is False
+    assert api.get("Lease", a.lease_name,
+                   "kubeflow")["spec"]["holderIdentity"] == "mgr-a"
+
+
+def test_standby_steals_expired_lease(lease_api):
+    api, clock = lease_api
+    a, b = elector(api, "mgr-a"), elector(api, "mgr-b")
+    a.try_acquire_or_renew()
+    clock.advance(seconds=16)           # past leaseDurationSeconds
+    assert b.try_acquire_or_renew() is True
+    spec = api.get("Lease", a.lease_name, "kubeflow")["spec"]
+    assert spec["holderIdentity"] == "mgr-b"
+    assert spec["leaseTransitions"] == 1
+    # the dead leader's next round is a definitive loss
+    assert a.try_acquire_or_renew() is False
+
+
+def test_steal_is_fenced_by_resource_version(lease_api):
+    """Two candidates racing one expired lease: the slower CAS loses
+    with a Conflict instead of clobbering the new holder."""
+    api, clock = lease_api
+    a, b = elector(api, "mgr-a"), elector(api, "mgr-b")
+    a.try_acquire_or_renew()
+    clock.advance(seconds=20)
+    stale = api.get("Lease", b.lease_name, "kubeflow")  # b's read
+
+    class StaleReader:
+        """b's view: reads return the pre-race snapshot."""
+        def __getattr__(self, name):
+            return getattr(api, name)
+
+        def try_get(self, *a_, **k):
+            import copy
+            return copy.deepcopy(stale)
+
+    b.api = StaleReader()
+    # a steals first (rv bumps)...
+    assert a.try_acquire_or_renew() is True
+    # ...so b's update, carrying the stale rv, is rejected
+    assert b.try_acquire_or_renew() is False
+    assert api.get("Lease", b.lease_name,
+                   "kubeflow")["spec"]["holderIdentity"] == "mgr-a"
+    # and the raw stale write really does 409 at the apiserver
+    with pytest.raises(Conflict):
+        api.update(stale)
+
+
+def test_release_hands_over_immediately(lease_api):
+    api, clock = lease_api
+    a, b = elector(api, "mgr-a"), elector(api, "mgr-b")
+    a.try_acquire_or_renew()
+    a.release()
+    clock.advance(seconds=1)            # lease far from expired
+    assert b.try_acquire_or_renew() is True
+
+
+def test_elector_creates_missing_namespace(lease_api):
+    api, _ = lease_api
+    a = elector(api, "mgr-a", namespace="brand-new")
+    assert a.try_acquire_or_renew() is False  # first round: ns created
+    assert a.try_acquire_or_renew() is True
+
+
+def test_leader_gauge_and_callbacks(lease_api):
+    api, clock = lease_api
+    a = elector(api, "gauge-probe")
+    events = []
+    a.on_started_leading.append(lambda: events.append("up"))
+    a.on_stopped_leading.append(lambda: events.append("down"))
+    a._set_leader(a.try_acquire_or_renew(), clock())
+    assert events == ["up"]
+    assert metrics.registry_value(
+        "leader_is_leader", {"identity": "gauge-probe"}) == 1.0
+    a._set_leader(False, clock())
+    assert events == ["up", "down"]
+    assert metrics.registry_value(
+        "leader_is_leader", {"identity": "gauge-probe"}) == 0.0
+
+
+def test_two_elector_threads_fail_over():
+    """Real threads, real time: kill the leader without release and the
+    standby takes over within one lease duration."""
+    api = APIServer()
+    api.ensure_namespace("kubeflow")
+    kw = dict(lease_duration_s=0.4, renew_deadline_s=0.3,
+              retry_period_s=0.05)
+    a = LeaderElector(api, "mgr-a", **kw)
+    b = LeaderElector(api, "mgr-b", **kw)
+    stop_a, stop_b = threading.Event(), threading.Event()
+    ta = threading.Thread(target=a.run, args=(stop_a,), daemon=True)
+    tb = threading.Thread(target=b.run, args=(stop_b,), daemon=True)
+    ta.start()
+    deadline = time.monotonic() + 2.0
+    while not a.is_leader and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert a.is_leader
+    tb.start()
+    time.sleep(0.15)
+    assert not b.is_leader               # standby while a renews
+    stop_a.set()                         # crash-style: no release
+    ta.join(timeout=1.0)
+    t0 = time.monotonic()
+    deadline = t0 + 2.0                  # >> lease_duration + retry
+    while not b.is_leader and time.monotonic() < deadline:
+        time.sleep(0.01)
+    takeover = time.monotonic() - t0
+    assert b.is_leader, "standby never took over"
+    assert takeover < 2.0
+    stop_b.set()
+    tb.join(timeout=1.0)
+
+
+# ---- Manager integration ---------------------------------------------
+
+def test_manager_runs_on_workqueues():
+    api, mgr = make_control_plane()
+    api.ensure_namespace("user1")
+    assert set(mgr._queues) == {c.name for c in mgr.controllers}
+    from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+    api.create(make_notebook("wq", "user1"))
+    mgr.run_until_idle()
+    assert api.get("StatefulSet", "wq", "user1") is not None
+    for q in mgr._queues.values():
+        assert q.depth() == 0
+
+
+def test_run_forever_standby_does_not_reconcile():
+    """A manager whose elector is not leader must not touch the
+    cluster; on promotion it resyncs and converges."""
+    api, mgr = make_control_plane()
+    api.ensure_namespace("user1")
+    from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+
+    class FakeElector:
+        def __init__(self):
+            self.is_leader = False
+            self.on_started_leading = []
+            self.on_stopped_leading = []
+            self.identity = "fake"
+
+        def run(self, stop):
+            stop.wait()
+
+    el = FakeElector()
+    stop = threading.Event()
+    t = threading.Thread(target=mgr.run_forever,
+                         kwargs=dict(stop=stop, poll_interval_s=0.02,
+                                     elector=el), daemon=True)
+    t.start()
+    api.create(make_notebook("gated", "user1"))
+    time.sleep(0.2)
+    assert api.try_get("StatefulSet", "gated", "user1") is None
+    el.is_leader = True
+    for cb in el.on_started_leading:
+        cb()
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        if api.try_get("StatefulSet", "gated", "user1") is not None:
+            break
+        time.sleep(0.02)
+    assert api.try_get("StatefulSet", "gated", "user1") is not None
+    stop.set()
+    t.join(timeout=2.0)
+
+
+# ---- TokenBucket -----------------------------------------------------
+
+def test_token_bucket_burst_then_steady_rate():
+    clk = ManualClock()
+    slept = []
+    tb = TokenBucket(qps=10.0, burst=3, clock=clk, sleep=slept.append)
+    for _ in range(3):
+        assert tb.acquire() == 0.0       # burst capacity, no wait
+    w = tb.acquire()                     # bucket dry: wait 1/qps
+    assert w == pytest.approx(0.1)
+    assert slept == [pytest.approx(0.1)]
+    assert tb.throttled_calls == 1
+    assert tb.throttled_seconds == pytest.approx(0.1)
+
+
+def test_token_bucket_refills_and_caps_at_burst():
+    clk = ManualClock()
+    tb = TokenBucket(qps=10.0, burst=2, clock=clk, sleep=lambda s: None)
+    tb.acquire()
+    tb.acquire()
+    clk.advance(10.0)                    # long idle: refill caps at 2
+    assert tb.acquire() == 0.0
+    assert tb.acquire() == 0.0
+    assert tb.acquire() > 0.0
+
+
+def test_token_bucket_queues_waiters_fifo():
+    clk = ManualClock()
+    tb = TokenBucket(qps=1.0, burst=1, clock=clk, sleep=lambda s: None)
+    tb.acquire()
+    assert tb.acquire() == pytest.approx(1.0)
+    assert tb.acquire() == pytest.approx(2.0)  # debt accumulates
+
+
+def test_token_bucket_rejects_bad_qps():
+    with pytest.raises(ValueError):
+        TokenBucket(qps=0)
+
+
+def test_kube_client_wires_limiter_and_identity():
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+    )
+    api = KubeAPIServer("http://127.0.0.1:1", qps=5.0, burst=7,
+                        identity="mgr-0")
+    assert api.limiter is not None
+    assert api.limiter.qps == 5.0
+    assert api.limiter.burst == 7
+    assert api.identity == "mgr-0"
+    unthrottled = KubeAPIServer("http://127.0.0.1:1")
+    assert unthrottled.limiter is None
+
+
+def test_kube_client_throttle_debits_limiter():
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+    )
+    api = KubeAPIServer("http://127.0.0.1:1", qps=100.0, burst=2)
+    clk = ManualClock()
+    api.limiter = TokenBucket(qps=100.0, burst=2, clock=clk,
+                              sleep=lambda s: None)
+    for _ in range(3):
+        api._throttle()
+    assert api.limiter.throttled_calls == 1
+
+
+# ---- dashboard surfacing ---------------------------------------------
+
+def test_dashboard_metrics_expose_controlplane_section():
+    """/api/metrics grows a controlplane section: lease holder from the
+    store plus the in-process HA gauges."""
+    import json
+
+    from kubeflow_rm_tpu.controlplane.webapps.dashboard import create_app
+
+    api, mgr = make_control_plane()
+    api.ensure_namespace("kubeflow")
+    el = LeaderElector(api, "dash-mgr")
+    assert el.try_acquire_or_renew() is True
+    el._set_leader(True, api.clock())
+    app = create_app(api, history_interval_s=0)
+    client = app.test_client(user="alice@corp.com")
+    resp = client.get("/api/metrics")
+    assert resp.status_code == 200, resp.get_data()
+    cp = json.loads(resp.get_data())["controlplane"]
+    assert cp["leader"] == "dash-mgr"
+    assert cp["lease_transitions"] == 0
+    assert metrics.registry_value(
+        "leader_is_leader", {"identity": "dash-mgr"}) == 1.0
+    assert cp["is_leader"] >= 1.0
+    assert cp["workqueue_depth"] == metrics.registry_value(
+        "workqueue_depth")
+    assert cp["workqueue_requeues"] == metrics.registry_value(
+        "workqueue_requeues_total")
+    el._set_leader(False, api.clock())
+
+
+def test_prometheus_backend_parses_controlplane_gauges():
+    from kubeflow_rm_tpu.controlplane.webapps.metrics_service import (
+        PrometheusMetricsService,
+    )
+
+    svc = PrometheusMetricsService("http://unused")
+    svc._scrape = lambda: {
+        "leader_is_leader": 1.0,
+        "workqueue_depth": 3.0,
+        "workqueue_requeues_total": 7.0,
+        "notebook_running": 2.0,
+    }
+    cp = svc.snapshot()["controlplane"]
+    assert cp["is_leader"] == 1.0
+    assert cp["workqueue_depth"] == 3.0
+    assert cp["workqueue_requeues"] == 7.0
+
+
+# ---- write log -------------------------------------------------------
+
+def test_apiserver_write_log_attributes_writers():
+    api = APIServer()
+    api.ensure_namespace("user1")
+    api.set_writer("mgr-a")
+    api.create({"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "cm", "namespace": "user1"}})
+    api.set_writer(None)
+    cm = api.get("ConfigMap", "cm", "user1")
+    cm["data"] = {"k": "v"}
+    api.update(cm)
+    log = [e for e in api.write_log if e["kind"] == "ConfigMap"]
+    assert [(e["verb"], e["writer"]) for e in log] == [
+        ("CREATE", "mgr-a"), ("UPDATE", None)]
+    assert log[0]["seq"] < log[1]["seq"]

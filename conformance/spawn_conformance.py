@@ -74,6 +74,7 @@ def wallclock_main(args) -> int:
     p95s = sorted(r["provision_p95_ms"] for r in runs)
     result = {
         "mode": "wallclock",
+        "cache": "off" if args.no_cache else "on",
         "notebooks": args.notebooks,
         "concurrency": max(1, args.concurrency),
         "slice": runs[0]["slice"],
@@ -203,7 +204,8 @@ def _wallclock_once(args, phases) -> dict:
     # -- the platform: controller manager through the kube adapter --
     kapi = KubeAPIServer(rest.url, qps=args.qps or None,
                          burst=args.burst or None,
-                         identity="conformance-manager")
+                         identity="conformance-manager",
+                         cache_reads=not args.no_cache)
     mgr = make_cluster_manager(kapi, enable_culling=False)
     for kind in WATCHED_KINDS:
         threading.Thread(target=kapi.watch_kind,
@@ -218,7 +220,7 @@ def _wallclock_once(args, phases) -> dict:
     from werkzeug.serving import make_server
 
     from kubeflow_rm_tpu.controlplane.webapps import jupyter as jwa
-    japi = KubeAPIServer(rest.url)
+    japi = KubeAPIServer(rest.url, cache_reads=not args.no_cache)
     # the SPA polls notebook status: serve those reads from informers
     # exactly like the manager does (SARs stay live, behind the webapp
     # core's short-TTL decision cache)
@@ -365,6 +367,10 @@ def main() -> int:
     ap.add_argument("--burst", type=int, default=0,
                     help="manager kube-client burst (with --qps); the "
                          "reference's --burst")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the shared informer read cache (all "
+                         "reads live, no no-op write suppression) — "
+                         "the A/B baseline arm for PROVISION_r{N}.json")
     ap.add_argument("--out", default="",
                     help="also write the result JSON to this file "
                          "(PROVISION_r{N}.json artifact)")
@@ -372,7 +378,7 @@ def main() -> int:
     if args.wallclock:
         return wallclock_main(args)
 
-    api, mgr = make_control_plane()
+    api, mgr = make_control_plane(cache=not args.no_cache)
 
     # fake fleet: enough hosts for every requested slice
     pools = []

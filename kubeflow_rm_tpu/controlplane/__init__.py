@@ -19,11 +19,13 @@ from kubeflow_rm_tpu.controlplane.apiserver import APIServer
 
 def make_control_plane(clock=None, *, auto_ready: bool = True,
                        enable_culling: bool = False,
-                       culler_config=None):
+                       culler_config=None, cache: bool = True):
     """Build (api, manager) with every controller and webhook wired.
 
     ``clock`` is injectable for deterministic culling tests;
-    ``auto_ready=False`` leaves scheduled pods un-Ready for status tests.
+    ``auto_ready=False`` leaves scheduled pods un-Ready for status tests;
+    ``cache=False`` runs the manager on the raw verb surface (the A/B
+    baseline arm of ``spawn_conformance --no-cache``).
     """
     from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
     from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
@@ -76,7 +78,13 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
         SliceHealthController,
     )
 
-    manager = Manager(api)
+    # the Manager (and through it every controller) reads through the
+    # shared informer cache; the raw api is returned so tests and web
+    # apps keep their direct handle on the backing store. The informer
+    # registers its watcher BEFORE the Manager's, so the store is
+    # already updated when a reconcile fires for an event.
+    from kubeflow_rm_tpu.controlplane.cache import CachedAPI
+    manager = Manager(CachedAPI(api) if cache else api)
     manager.add(NotebookController())
     manager.add(LockReleaseController())
     manager.add(AuthCompanionController())
@@ -129,6 +137,12 @@ def make_cluster_manager(api, *, enable_culling: bool = True,
         LockReleaseController,
     )
 
+    from kubeflow_rm_tpu.controlplane.cache import CachedAPI
+    if not isinstance(api, CachedAPI):
+        # against the kube adapter this adopts the adapter's informer-
+        # fed ObjectStore (one cache, two consumers); reads stay
+        # fall-through until the watch threads sync each kind
+        api = CachedAPI(api)
     manager = Manager(api)
     manager.add(NotebookController())
     manager.add(LockReleaseController())

@@ -78,6 +78,17 @@ def _webapp(module: str, default_port: int) -> None:
     mod = importlib.import_module(
         f"kubeflow_rm_tpu.controlplane.webapps.{module}")
     api = _kube_api()
+    if _env_flag("WEBAPP_INFORMER_CACHE", True):
+        # web-app list endpoints are read-dominated: run the same
+        # informer watch loops the controller manager does so index
+        # pages serve from memory instead of a live LIST per request
+        from kubeflow_rm_tpu.controlplane import WATCHED_KINDS
+        stop = threading.Event()
+        for kind in WATCHED_KINDS:
+            threading.Thread(
+                target=api.watch_kind, args=(kind, None, stop),
+                daemon=True, name=f"watch-{kind}").start()
+        api.wait_for_sync(WATCHED_KINDS, timeout=30.0)
     app = mod.create_app(
         api, disable_auth=_env_flag("DISABLE_AUTH"),
         prefix=os.environ.get("APP_PREFIX", ""))
@@ -123,6 +134,14 @@ def cmd_controller_manager() -> int:
     ]
     for t in threads:
         t.start()
+    # gate on the informers' initial lists so the seed resync (and
+    # every reconcile it triggers) reads from memory instead of racing
+    # the watch threads with live GETs. Best-effort: on timeout the
+    # cache serves whatever synced and the rest falls through.
+    if not api.wait_for_sync(WATCHED_KINDS, timeout=30.0):
+        logging.getLogger("kubeflow_rm_tpu").warning(
+            "informer cache not fully synced after 30s; unsynced kinds "
+            "fall through to live reads")
     manager.enqueue_all()
     logging.getLogger("kubeflow_rm_tpu").info(
         "controller manager %s running (%d controllers, %d watches, "

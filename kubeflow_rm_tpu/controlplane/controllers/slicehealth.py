@@ -54,8 +54,10 @@ class SliceHealthController(Controller):
 
         # a multislice job is ONE gang: any slice's failure restarts all
         hosts = nb_api.total_hosts(nb)
+        # scan(): phase/labels are only read here; deletes go through
+        # the verb surface by name
         pods = [
-            p for p in api.list("Pod", req.namespace)
+            p for p in getattr(api, "scan", api.list)("Pod", req.namespace)
             if (p["metadata"].get("labels") or {}).get(
                 nb_api.NOTEBOOK_NAME_LABEL) == req.name
             and not p["metadata"].get("deletionTimestamp")

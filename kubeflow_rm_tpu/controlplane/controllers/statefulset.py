@@ -337,7 +337,10 @@ class StatefulSetController(Controller):
             return node
         allow_virtual = (self.virtual_node_fallback
                          if self.virtual_node_fallback is not None
-                         else isinstance(api, APIServer))
+                         # unwrap a CachedAPI: the backend decides —
+                         # hermetic in-memory yes, real cluster no
+                         else isinstance(getattr(api, "api", api),
+                                         APIServer))
         if allow_virtual and not selector and not need:
             # plain CPU pod: runnable even in a test with no Node inventory
             return {"metadata": {"name": "virtual-node"}}
@@ -372,7 +375,7 @@ class DeploymentController(StatefulSetController):
 
     def _mirror_status(self, api: APIServer, deploy: dict) -> None:
         ns = namespace_of(deploy)
-        pods = [p for p in api.list("Pod", ns)
+        pods = [p for p in getattr(api, "scan", api.list)("Pod", ns)
                 if any(r.get("uid") == deploy["metadata"]["uid"]
                        for r in p["metadata"].get("ownerReferences", []))]
         ready = sum(

@@ -28,6 +28,7 @@ import datetime
 import json
 import logging
 import threading
+import time
 from typing import Callable
 
 from kubeflow_rm_tpu.controlplane.api.meta import (
@@ -366,12 +367,16 @@ class KubeAPIServer:
         self._watchers: list[Callable[[str, dict, dict | None], None]] = []
         self._event_seq = 0
         self._event_lock = threading.Lock()
-        # informer read cache (see the cache section below): kind ->
-        # {(ns, name): obj}; a kind serves reads only once synced
+        # informer read cache: the shared indexed ObjectStore
+        # (controlplane/cache/store.py); a kind serves reads only once
+        # its initial list has synced. ``cache_reads=False`` keeps the
+        # store cold (nothing applied, nothing served) — the
+        # conformance A/B's no-cache arm.
         self._cache_reads = cache_reads
-        self._cache: dict[str, dict[tuple, dict]] = {}
-        self._cache_synced: set[str] = set()
-        self._cache_lock = threading.Lock()
+        from kubeflow_rm_tpu.controlplane.cache.store import ObjectStore
+        self.cache = ObjectStore(cluster_scoped={
+            k for k, (_, _, namespaced) in RESOURCES.items()
+            if not namespaced})
 
     # ---- informer read cache -----------------------------------------
     # controller-runtime's default client serves get/list from the
@@ -382,37 +387,23 @@ class KubeAPIServer:
     # after its informer's initial list (``watch_kind``) has synced;
     # writes are applied to the cache from the server's response
     # (read-your-writes within a reconcile), and watch events reconcile
-    # the rest — rv-compared so an older event never rolls back a newer
-    # write.
-
-    def _cache_key(self, kind: str, name: str, namespace: str | None):
-        _, _, namespaced = RESOURCES.get(kind, (None, None, True))
-        return (namespace if namespaced else None, name)
-
-    @staticmethod
-    def _rv_of(obj: dict) -> int:
-        try:
-            return int((obj.get("metadata") or {})
-                       .get("resourceVersion", 0))
-        except (TypeError, ValueError):
-            return 0
+    # the rest — the store's rv comparison keeps an older event from
+    # rolling back a newer write, and its tombstones keep a racing
+    # relist from resurrecting a deletion.
 
     def _cache_apply(self, etype: str, obj: dict) -> None:
-        kind = obj.get("kind")
-        if not kind:
-            return
-        key = self._cache_key(kind, name_of(obj), namespace_of(obj))
-        with self._cache_lock:
-            store = self._cache.setdefault(kind, {})
-            if etype == "DELETED":
-                store.pop(key, None)
-            else:
-                cur = store.get(key)
-                if cur is None or self._rv_of(obj) >= self._rv_of(cur):
-                    store[key] = obj
+        if self._cache_reads:
+            self.cache.apply(etype, obj)
 
     def _cache_serves(self, kind: str) -> bool:
-        return self._cache_reads and kind in self._cache_synced
+        return self._cache_reads and self.cache.is_synced(kind)
+
+    def wait_for_sync(self, kinds, timeout: float | None = None) -> bool:
+        """Block until every kind's informer completed its initial list
+        (vacuously true with the cache disabled)."""
+        if not self._cache_reads:
+            return True
+        return self.cache.wait_for_sync(kinds, timeout)
 
     @property
     def _session(self):
@@ -491,9 +482,7 @@ class KubeAPIServer:
     def get(self, kind: str, name: str,
             namespace: str | None = None) -> dict:
         if self._cache_serves(kind):
-            key = self._cache_key(kind, name, namespace)
-            with self._cache_lock:
-                obj = self._cache.get(kind, {}).get(key)
+            obj = self.cache.get_ref(kind, name, namespace)
             if obj is None:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             return fast_deepcopy(obj)
@@ -512,20 +501,8 @@ class KubeAPIServer:
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict | None = None) -> list[dict]:
         if self._cache_serves(kind):
-            from kubeflow_rm_tpu.controlplane.api.meta import (
-                labels_of, matches_selector,
-            )
-            with self._cache_lock:
-                objs = list(self._cache.get(kind, {}).values())
-            out = [
-                fast_deepcopy(o) for o in objs
-                if (namespace is None
-                    or namespace_of(o) == namespace)
-                and (not label_selector
-                     or matches_selector(labels_of(o), label_selector))
-            ]
-            out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
-            return out
+            return [fast_deepcopy(o) for o in
+                    self.cache.list_refs(kind, namespace, label_selector)]
         self._throttle()
         resp = self._session.get(
             self._collection_url(kind, namespace),
@@ -535,6 +512,15 @@ class KubeAPIServer:
         for it in items:  # list responses omit kind/apiVersion per item
             it.setdefault("kind", kind)
         return items
+
+    def scan(self, kind: str, namespace: str | None = None) -> list[dict]:
+        """READ-ONLY ``list`` (store references, no copies) when the
+        kind is cache-served; falls back to a live ``list`` otherwise.
+        Same caller contract as the in-memory apiserver's ``scan``:
+        never mutate the returned objects."""
+        if self._cache_serves(kind):
+            return self.cache.list_refs(kind, namespace)
+        return self.list(kind, namespace)
 
     def update(self, obj: dict) -> dict:
         kind = obj["kind"]
@@ -586,12 +572,12 @@ class KubeAPIServer:
             self._object_url(kind, name, namespace))
         self._raise_for(resp, f"delete {kind} {namespace}/{name}")
         # optimistic: a finalizer-bearing object isn't really gone;
-        # its MODIFIED watch event restores the cache entry within
-        # watch latency, and level-triggered reconciles tolerate the
-        # brief miss (a re-delete gets NotFound, a no-op)
-        with self._cache_lock:
-            self._cache.get(kind, {}).pop(
-                self._cache_key(kind, name, namespace), None)
+        # its MODIFIED watch event (rv above the discard tombstone)
+        # restores the cache entry within watch latency, and
+        # level-triggered reconciles tolerate the brief miss (a
+        # re-delete gets NotFound, a no-op)
+        if self._cache_reads:
+            self.cache.discard(kind, name, namespace)
 
     def ensure_namespace(self, namespace: str) -> dict:
         found = self.try_get("Namespace", namespace)
@@ -713,15 +699,14 @@ class KubeAPIServer:
         for item in items:
             item.setdefault("kind", kind)
         if self._cache_reads and namespace is None:
-            # (re)list replaces the kind's store wholesale — objects
-            # deleted while the watch was down drop out — and marks
-            # the kind cache-served from here on
-            with self._cache_lock:
-                self._cache[kind] = {
-                    self._cache_key(kind, name_of(it), namespace_of(it)):
-                        it for it in items
-                }
-                self._cache_synced.add(kind)
+            # (re)list replaces the kind's store contents — objects
+            # deleted while the watch was down drop out, entries newer
+            # than the snapshot survive (ObjectStore.replace's horizon
+            # merge) — and marks the kind cache-served from here on
+            self.cache.replace(kind, items)
+            from kubeflow_rm_tpu.controlplane import metrics
+            metrics.INFORMER_SYNCED_KINDS.set(
+                len(self.cache.synced_kinds()))
         for item in items:
             self._fan("ADDED", item)
         return body.get("metadata", {}).get("resourceVersion", "")
@@ -766,6 +751,11 @@ class KubeAPIServer:
     def _fan(self, etype: str, obj: dict) -> None:
         if self._cache_reads:
             self._cache_apply(etype, obj)
+            from kubeflow_rm_tpu.controlplane import metrics
+            kind = obj.get("kind")
+            if kind:
+                metrics.INFORMER_EVENTS_TOTAL.labels(kind=kind).inc()
+            metrics.INFORMER_LAST_EVENT_TIMESTAMP.set(time.time())
         for w in list(self._watchers):
             try:
                 w(etype, obj, None)

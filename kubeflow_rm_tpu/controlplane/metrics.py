@@ -102,6 +102,46 @@ WORKQUEUE_QUEUE_SECONDS = Histogram(
     registry=REGISTRY,
 )
 
+# ---- informer cache (controlplane/cache): reads, suppression ---------
+CACHE_READS_TOTAL = Counter(
+    "cache_reads_total",
+    "Read verbs against the CachedAPI by verb and whether the shared "
+    "informer store served them (hit) or they fell through (miss)",
+    ["verb", "result"],
+    registry=REGISTRY,
+)
+CACHE_SUPPRESSED_WRITES_TOTAL = Counter(
+    "cache_suppressed_writes_total",
+    "Writes dropped by no-op suppression (desired object semantically "
+    "equal to the cached current one after normalization)",
+    ["verb"],
+    registry=REGISTRY,
+)
+CACHE_CONFLICT_FASTPATH_TOTAL = Counter(
+    "cache_conflict_fastpath_total",
+    "Conflict resolutions attempted from the cache: noop (write already "
+    "reflected in latest), rebased (disjoint three-way rebase retried), "
+    "fallthrough (re-raised for the caller's retry loop)",
+    ["result"],
+    registry=REGISTRY,
+)
+INFORMER_EVENTS_TOTAL = Counter(
+    "informer_events_total",
+    "Watch events folded into the shared informer store, per kind",
+    ["kind"],
+    registry=REGISTRY,
+)
+INFORMER_SYNCED_KINDS = Gauge(
+    "informer_synced_kinds",
+    "Kinds whose initial list completed (serving reads from memory)",
+    registry=REGISTRY,
+)
+INFORMER_LAST_EVENT_TIMESTAMP = Gauge(
+    "informer_last_event_timestamp_seconds",
+    "Wall time the informer last folded an event in (staleness proxy)",
+    registry=REGISTRY,
+)
+
 
 def registry_value(sample_name: str,
                    labels: dict[str, str] | None = None) -> float:

@@ -120,9 +120,12 @@ class Manager:
 
     def enqueue_all(self) -> None:
         """Seed every controller's queue with all existing primaries
-        (informer initial list)."""
+        (informer initial list; also the leader-promotion resync).
+        ``scan`` — only names/namespaces are read, so the read-only
+        reference contract holds and a cache-backed api serves the
+        whole resync from memory with zero server round-trips."""
         for c in self.controllers:
-            for obj in self.api.list(c.kind):
+            for obj in getattr(self.api, "scan", self.api.list)(c.kind):
                 self.enqueue(c, Request(namespace_of(obj), name_of(obj)))
 
     def _on_event(self, event: str, obj: dict, old: dict | None) -> None:

@@ -54,6 +54,16 @@ def _controlplane_section(api=None) -> dict:
             spec = lease.get("spec") or {}
             leader = spec.get("holderIdentity") or None
             transitions = spec.get("leaseTransitions")
+    # informer-cache health: the api's shared ObjectStore when it has
+    # one (KubeAPIServer.cache / CachedAPI.store), else in-process
+    # gauge sums — works for both backends
+    cache_stats = None
+    store = getattr(api, "cache", None) or getattr(api, "store", None)
+    if store is not None and hasattr(store, "stats"):
+        try:
+            cache_stats = store.stats()
+        except Exception:  # noqa: BLE001 - pills are best-effort
+            cache_stats = None
     return {
         "leader": leader,
         "lease_transitions": transitions,
@@ -63,6 +73,27 @@ def _controlplane_section(api=None) -> dict:
             "workqueue_requeues_total"),
         "retries_exhausted": cp_metrics.registry_value(
             "workqueue_retries_exhausted_total"),
+        "cache": {
+            "objects": cache_stats["objects"] if cache_stats else None,
+            "synced_kinds": (cache_stats["synced_kinds"]
+                             if cache_stats else
+                             cp_metrics.registry_value(
+                                 "informer_synced_kinds")),
+            "events_applied": (cache_stats["events_applied"]
+                               if cache_stats else None),
+            "last_event_t": (cache_stats["last_event_t"]
+                             if cache_stats else
+                             cp_metrics.registry_value(
+                                 "informer_last_event_timestamp_seconds")),
+            "hits": cp_metrics.registry_value(
+                "cache_reads_total", {"result": "hit"}),
+            "misses": cp_metrics.registry_value(
+                "cache_reads_total", {"result": "miss"}),
+            "suppressed_writes": cp_metrics.registry_value(
+                "cache_suppressed_writes_total"),
+            "conflict_fastpath": cp_metrics.registry_value(
+                "cache_conflict_fastpath_total"),
+        },
     }
 
 
@@ -75,9 +106,10 @@ class InventoryMetricsService:
 
     def snapshot(self) -> dict:
         api = self.api
+        scan = getattr(api, "scan", api.list)  # read-only references
         per_type: dict[str, dict] = {}
         used_by_node: dict[str, float] = {}
-        for pod in api.list("Pod"):
+        for pod in scan("Pod"):
             node = deep_get(pod, "spec", "nodeName")
             if not node:
                 continue
@@ -91,7 +123,7 @@ class InventoryMetricsService:
             if chips:
                 used_by_node[node] = used_by_node.get(node, 0.0) + chips
         nodes = 0
-        for node in api.list("Node"):
+        for node in scan("Node"):
             labels = node["metadata"].get("labels") or {}
             accel = labels.get(tpu_api.NODE_LABEL_ACCELERATOR)
             if not accel:
@@ -107,7 +139,7 @@ class InventoryMetricsService:
                 node["metadata"]["name"], 0.0)
             entry["nodes"] += 1
         running = 0
-        for nb in api.list("Notebook"):
+        for nb in scan("Notebook"):
             if (nb.get("status") or {}).get("readyReplicas"):
                 running += 1
         return {
@@ -180,6 +212,21 @@ class PrometheusMetricsService:
                 "workqueue_requeues": g.get("workqueue_requeues_total"),
                 "retries_exhausted": g.get(
                     "workqueue_retries_exhausted_total"),
+                "cache": {
+                    "objects": None,  # not exported as a flat gauge
+                    "synced_kinds": g.get("informer_synced_kinds"),
+                    "events_applied": g.get("informer_events_total"),
+                    "last_event_t": g.get(
+                        "informer_last_event_timestamp_seconds"),
+                    # hit/miss labels are summed by the flat scrape, so
+                    # only the combined read count survives here
+                    "hits": g.get("cache_reads_total"),
+                    "misses": None,
+                    "suppressed_writes": g.get(
+                        "cache_suppressed_writes_total"),
+                    "conflict_fastpath": g.get(
+                        "cache_conflict_fastpath_total"),
+                },
             },
         }
 

@@ -1,0 +1,379 @@
+"""Shared informer read cache (``controlplane/cache/``): the indexed
+store's invariants, the ``CachedAPI``'s read-your-writes + no-op write
+suppression + conflict fast-path, 410-Gone relist recovery through the
+kube adapter, and the headline perf contract — a steady-state reconcile
+of an unchanged Notebook issues ZERO write verbs."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane
+from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+from kubeflow_rm_tpu.controlplane.api.meta import make_object
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.api.profile import make_profile
+from kubeflow_rm_tpu.controlplane.apiserver import (
+    APIServer,
+    Conflict,
+    NotFound,
+)
+from kubeflow_rm_tpu.controlplane.cache import CachedAPI, ObjectStore
+from kubeflow_rm_tpu.controlplane.cache.store import rv_of
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+    make_tpu_node,
+)
+
+
+def obj(kind, name, ns="u", rv=1, labels=None, owners=None):
+    o = make_object("v1", kind, name, ns)
+    o["metadata"]["resourceVersion"] = str(rv)
+    if labels:
+        o["metadata"]["labels"] = dict(labels)
+    if owners:
+        o["metadata"]["ownerReferences"] = [
+            {"uid": u, "kind": "Notebook", "name": "x"} for u in owners]
+    return o
+
+
+# ---- ObjectStore: index invariants -----------------------------------
+
+def test_store_indices_track_add_update_delete():
+    s = ObjectStore()
+    s.apply("ADDED", obj("Pod", "a", labels={"app": "x"},
+                         owners=["uid-1"]))
+    s.apply("ADDED", obj("Pod", "b", ns="v", labels={"app": "x"}))
+    assert [o["metadata"]["name"]
+            for o in s.list_refs("Pod", "u")] == ["a"]
+    assert len(s.list_refs("Pod", None, {"app": "x"})) == 2
+    assert [o["metadata"]["name"]
+            for o in s.owned_by("uid-1")] == ["a"]
+    # relabel: the old index entry must not linger
+    s.apply("MODIFIED", obj("Pod", "a", rv=2, labels={"app": "y"},
+                            owners=["uid-2"]))
+    assert s.list_refs("Pod", "u", {"app": "x"}) == []
+    assert len(s.list_refs("Pod", "u", {"app": "y"})) == 1
+    assert s.owned_by("uid-1") == []
+    assert len(s.owned_by("uid-2")) == 1
+    # delete: gone from every index
+    s.apply("DELETED", obj("Pod", "a", rv=3))
+    assert s.get_ref("Pod", "a", "u") is None
+    assert s.list_refs("Pod", "u") == []
+    assert s.owned_by("uid-2") == []
+    # the other namespace's object is untouched
+    assert len(s.list_refs("Pod", "v")) == 1
+
+
+def test_store_cluster_scoped_kinds_key_under_none():
+    s = ObjectStore()
+    p = obj("Profile", "team", ns=None)
+    p["metadata"].pop("namespace", None)
+    s.apply("ADDED", p)
+    # callers pass whatever namespace they like; the key ignores it
+    assert s.get_ref("Profile", "team", "anything") is p
+    assert s.get_ref("Profile", "team", None) is p
+
+
+def test_store_label_selector_expressions():
+    s = ObjectStore()
+    s.apply("ADDED", obj("Pod", "a", labels={"app": "x", "tier": "web"}))
+    s.apply("ADDED", obj("Pod", "b", labels={"app": "x"}))
+    s.apply("ADDED", obj("Pod", "c", labels={"app": "z", "tier": "db"}))
+
+    def names(sel):
+        return [o["metadata"]["name"] for o in s.list_refs("Pod", "u", sel)]
+
+    assert names({"matchExpressions": [
+        {"key": "tier", "operator": "Exists"}]}) == ["a", "c"]
+    assert names({"matchExpressions": [
+        {"key": "tier", "operator": "DoesNotExist"}]}) == ["b"]
+    assert names({"matchExpressions": [
+        {"key": "app", "operator": "In", "values": ["x"]}]}) == ["a", "b"]
+    assert names({"matchExpressions": [
+        {"key": "app", "operator": "NotIn", "values": ["x"]}]}) == ["c"]
+    # matchLabels narrows through the label index, expressions still run
+    assert names({"matchLabels": {"app": "x"},
+                  "matchExpressions": [
+                      {"key": "tier", "operator": "Exists"}]}) == ["a"]
+    # bare-dict selector (the apiserver's shorthand)
+    assert names({"app": "z"}) == ["c"]
+
+
+def test_store_rv_monotonicity_and_delete_tombstones():
+    s = ObjectStore()
+    s.apply("ADDED", obj("Pod", "a", rv=5))
+    # stale event behind a folded-in write: ignored
+    s.apply("MODIFIED", obj("Pod", "a", rv=3, labels={"stale": "y"}))
+    assert "labels" not in s.get_ref("Pod", "a", "u")["metadata"]
+    # delete tombstones at max(event rv, current rv)
+    s.apply("DELETED", obj("Pod", "a", rv=6))
+    # a stale pre-delete event cannot resurrect the object
+    s.apply("MODIFIED", obj("Pod", "a", rv=6))
+    assert s.get_ref("Pod", "a", "u") is None
+    # a genuinely newer incarnation comes back
+    s.apply("ADDED", obj("Pod", "a", rv=9))
+    assert rv_of(s.get_ref("Pod", "a", "u")) == 9
+
+
+def test_store_replace_merges_against_racing_events():
+    s = ObjectStore()
+    # events that raced the relist: a newer write and a deletion
+    s.apply("ADDED", obj("Pod", "newer", rv=20))
+    s.apply("ADDED", obj("Pod", "dead", rv=4))
+    s.apply("DELETED", obj("Pod", "dead", rv=6))
+    snapshot = [obj("Pod", "newer", rv=10),   # stale copy: loses
+                obj("Pod", "dead", rv=5),     # deleted after: stays dead
+                obj("Pod", "fresh", rv=8)]
+    s.replace("Pod", snapshot)
+    assert s.is_synced("Pod")
+    assert rv_of(s.get_ref("Pod", "newer", "u")) == 20
+    assert s.get_ref("Pod", "dead", "u") is None
+    assert rv_of(s.get_ref("Pod", "fresh", "u")) == 8
+
+
+def test_store_wait_for_sync_blocks_and_wakes():
+    s = ObjectStore()
+    assert s.wait_for_sync(["Pod"], timeout=0.05) is False
+    woke = []
+
+    def waiter():
+        woke.append(s.wait_for_sync(["Pod", "Node"], timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    s.replace("Pod", [])
+    s.mark_synced("Node")
+    t.join(timeout=5)
+    assert woke == [True]
+    s.unsync("Node")
+    assert s.is_synced("Node") is False
+
+
+# ---- CachedAPI over the in-memory backend ----------------------------
+
+@pytest.fixture
+def capi():
+    api = APIServer()
+    api.ensure_namespace("u")
+    return api, CachedAPI(api)
+
+
+def _counter(name, labels=None):
+    return cp_metrics.registry_value(name, labels) or 0
+
+
+def test_cached_read_your_writes(capi):
+    api, c = capi
+    cm = make_object("v1", "ConfigMap", "cm", "u")
+    cm["data"] = {"k": "1"}
+    c.create(cm)
+    got = c.get("ConfigMap", "cm", "u")
+    assert got["data"] == {"k": "1"}
+    got["data"]["k"] = "2"
+    c.update(got)
+    # immediately visible — no watch latency window
+    assert c.get("ConfigMap", "cm", "u")["data"]["k"] == "2"
+    c.patch("ConfigMap", "cm", {"data": {"j": "3"}}, "u")
+    assert c.get("ConfigMap", "cm", "u")["data"] == {"k": "2", "j": "3"}
+    # reads are copies: mutating one must not poison the cache
+    c.get("ConfigMap", "cm", "u")["data"]["k"] = "HACKED"
+    assert c.get("ConfigMap", "cm", "u")["data"]["k"] == "2"
+    # scan returns references (identical object on repeat scans)
+    assert c.scan("ConfigMap", "u")[0] is c.scan("ConfigMap", "u")[0]
+    c.delete("ConfigMap", "cm", "u")
+    assert c.try_get("ConfigMap", "cm", "u") is None
+
+
+def test_noop_writes_suppressed(capi):
+    api, c = capi
+    cm = make_object("v1", "ConfigMap", "cm", "u")
+    cm["data"] = {"k": "1"}
+    c.create(cm)
+    cur = c.get("ConfigMap", "cm", "u")
+    writes_before = len(api.write_log)
+    sup_before = _counter("cache_suppressed_writes_total")
+
+    out = c.update(c.get("ConfigMap", "cm", "u"))  # identical: no-op
+    assert rv_of(out) == rv_of(cur)
+    same = c.get("ConfigMap", "cm", "u")
+    same["metadata"]["resourceVersion"] = "999999"  # volatile: ignored
+    c.update(same)
+    c.update_status(c.get("ConfigMap", "cm", "u"))  # same (absent) status
+    c.patch("ConfigMap", "cm", {"data": {"k": "1"}}, "u")  # merge no-op
+
+    assert len(api.write_log) == writes_before, \
+        "semantic no-ops must not reach the server"
+    assert _counter("cache_suppressed_writes_total") == sup_before + 4
+    # and a REAL change still writes
+    changed = c.get("ConfigMap", "cm", "u")
+    changed["data"]["k"] = "2"
+    c.update(changed)
+    assert len(api.write_log) == writes_before + 1
+
+
+def test_conflict_fastpath_rebases_disjoint_edits(capi):
+    api, c = capi
+    cm = make_object("v1", "ConfigMap", "cm", "u")
+    cm["data"] = {"a": "1", "b": "1"}
+    c.create(cm)
+    stale = c.get("ConfigMap", "cm", "u")
+    # concurrent writer lands first (through the cache, so the store's
+    # rv history holds both versions)
+    theirs = c.get("ConfigMap", "cm", "u")
+    theirs["data"]["b"] = "2"
+    c.update(theirs)
+
+    before = _counter("cache_conflict_fastpath_total",
+                      {"result": "rebased"})
+    stale["data"]["a"] = "9"  # disjoint path: rebasable
+    out = c.update(stale)
+    assert out["data"] == {"a": "9", "b": "2"}, \
+        "rebase must keep BOTH concurrent edits"
+    assert _counter("cache_conflict_fastpath_total",
+                    {"result": "rebased"}) == before + 1
+
+
+def test_conflict_fastpath_clash_reraises(capi):
+    api, c = capi
+    cm = make_object("v1", "ConfigMap", "cm", "u")
+    cm["data"] = {"a": "1"}
+    c.create(cm)
+    stale = c.get("ConfigMap", "cm", "u")
+    theirs = c.get("ConfigMap", "cm", "u")
+    theirs["data"]["a"] = "2"
+    c.update(theirs)
+    stale["data"]["a"] = "9"  # same path: a rebase would pick a winner
+    with pytest.raises(Conflict):
+        c.update(stale)
+    # the concurrent write survived untouched
+    assert c.get("ConfigMap", "cm", "u")["data"]["a"] == "2"
+
+
+def test_conflict_noop_returns_latest(capi):
+    api, c = capi
+    cm = make_object("v1", "ConfigMap", "cm", "u")
+    cm["data"] = {"a": "1"}
+    c.create(cm)
+    stale = c.get("ConfigMap", "cm", "u")
+    theirs = c.get("ConfigMap", "cm", "u")
+    theirs["data"]["a"] = "2"
+    latest = c.update(theirs)
+    stale["data"]["a"] = "2"  # stale rv but semantically == latest
+    out = c.update(stale)
+    assert rv_of(out) == rv_of(latest)
+
+
+def test_cache_miss_falls_through(capi):
+    api, c = capi
+    # a kind the server has never stored still primes (empty list) and
+    # NotFound semantics match the raw surface
+    with pytest.raises(NotFound):
+        c.get("ConfigMap", "ghost", "u")
+    assert c.try_get("ConfigMap", "ghost", "u") is None
+    assert c.list("ConfigMap", "u") == []
+
+
+# ---- the headline perf contract --------------------------------------
+
+def test_steady_state_reconcile_issues_zero_writes():
+    """Once a Notebook has converged, re-running EVERY controller over
+    it (the leader-promotion resync) must not touch the server: reads
+    come from the informer store and no-op suppression swallows the
+    rewrites. This is the r07 optimisation's acceptance invariant."""
+    api, mgr = make_control_plane()
+    for i in range(4):
+        api.create(make_tpu_node(f"v5p-{i}", "v5p-16"))
+    api.create(make_profile("user1", "user1@example.com"))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    api.create(make_notebook("nb", "user1", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    nb = api.get("Notebook", "nb", "user1")
+    assert nb["status"]["readyReplicas"] >= 1
+
+    writes_before = len(api.write_log)
+    mgr.enqueue_all()
+    n = mgr.run_until_idle()
+    assert n > 0  # the resync really did reconcile everything
+    new_writes = list(api.write_log)[writes_before:]
+    assert new_writes == [], \
+        f"steady-state resync issued writes: {new_writes}"
+
+
+# ---- kube adapter: sync gating + 410 relist recovery -----------------
+
+@pytest.fixture
+def cluster():
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+    )
+    from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+    api = APIServer()
+    api.ensure_namespace("u")
+    rest = RestServer(api)
+    rest.start()
+    kapi = KubeAPIServer(rest.url)
+    yield api, rest, kapi
+    rest.stop()
+
+
+def _eventually(fn, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_adapter_wait_for_sync_and_cache_disable(cluster):
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+    )
+    api, rest, kapi = cluster
+    assert kapi.wait_for_sync(["ConfigMap"], timeout=0.05) is False
+    stop = threading.Event()
+    t = threading.Thread(target=kapi.watch_kind,
+                         args=("ConfigMap", None, stop, 2), daemon=True)
+    t.start()
+    try:
+        assert kapi.wait_for_sync(["ConfigMap"], timeout=10) is True
+        assert kapi.cache.is_synced("ConfigMap")
+    finally:
+        stop.set()
+    # --no-cache arm: vacuous sync, cold store, reads fall through live
+    off = KubeAPIServer(rest.url, cache_reads=False)
+    assert off.wait_for_sync(["ConfigMap"], timeout=0) is True
+    assert off.cache.is_synced("ConfigMap") is False
+    api.create(make_object("v1", "ConfigMap", "live", "u"))
+    assert [o["metadata"]["name"] for o in off.scan("ConfigMap", "u")] \
+        == ["live"]
+
+
+def test_adapter_410_relist_recovers_cache(cluster):
+    api, rest, kapi = cluster
+    api.create(make_object("v1", "ConfigMap", "one", "u"))
+    stop = threading.Event()
+    # short watch timeout: the loop re-registers every second, which is
+    # what will trip over the moved backlog horizon below
+    t = threading.Thread(target=kapi.watch_kind,
+                         args=("ConfigMap", None, stop, 1), daemon=True)
+    t.start()
+    try:
+        assert kapi.wait_for_sync(["ConfigMap"], timeout=10)
+        assert kapi.get("ConfigMap", "one", "u")  # cache-served
+        # move the backlog horizon: the next rv-resume gets 410 Gone
+        # and the watch loop must RELIST (test_deploy's white-box trick)
+        with rest._watch_lock:
+            rest._backlog_floor = 10_000
+        # mutate while the watch is forced to relist
+        api.create(make_object("v1", "ConfigMap", "two", "u"))
+        api.delete("ConfigMap", "one", "u")
+        assert _eventually(
+            lambda: kapi.cache.get_ref("ConfigMap", "two", "u")
+            is not None
+            and kapi.cache.get_ref("ConfigMap", "one", "u") is None), \
+            "cache did not converge after 410-forced relist"
+    finally:
+        stop.set()

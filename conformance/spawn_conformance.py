@@ -75,6 +75,7 @@ def wallclock_main(args) -> int:
     result = {
         "mode": "wallclock",
         "cache": "off" if args.no_cache else "on",
+        "lock": "global" if args.global_lock else "sharded",
         "notebooks": args.notebooks,
         "concurrency": max(1, args.concurrency),
         "slice": runs[0]["slice"],
@@ -176,7 +177,7 @@ def _wallclock_once(args, phases) -> dict:
     stop = threading.Event()
 
     # -- the cluster: apiserver + admission + fake kubelet over REST --
-    capi = APIServer()
+    capi = APIServer(global_lock=args.global_lock)
     capi.register_validator(nb_api.KIND, nb_api.validate)
     capi.register_validator(pd_api.KIND, pd_api.validate)
     NotebookWebhook(capi).register()
@@ -320,6 +321,10 @@ def _wallclock_once(args, phases) -> dict:
                                topo.hosts, phases)
     finally:
         stop.set()
+        # flush in-flight fanout deliveries before tearing the sockets
+        # down — a watcher callback racing a closed RestServer would
+        # log spurious errors into the next run's output
+        capi.drain_watchers(timeout=10)
         httpd.shutdown()
         rest.stop()
 
@@ -371,14 +376,28 @@ def main() -> int:
                     help="disable the shared informer read cache (all "
                          "reads live, no no-op write suppression) — "
                          "the A/B baseline arm for PROVISION_r{N}.json")
+    ap.add_argument("--global-lock", action="store_true",
+                    help="run the apiserver on the pre-r08 single "
+                         "global RLock with synchronous watch delivery "
+                         "— the sharded/async A/B baseline arm")
+    ap.add_argument("--hang-dump", type=float, default=0.0, metavar="S",
+                    help="arm faulthandler to dump every thread's "
+                         "stack after S seconds (CI contention-stress "
+                         "deadlock canary; 0 = off)")
     ap.add_argument("--out", default="",
                     help="also write the result JSON to this file "
                          "(PROVISION_r{N}.json artifact)")
     args = ap.parse_args()
+    if args.hang_dump > 0:
+        # a deadlock in the sharded locking scheme must fail CI with
+        # stacks, not eat the job's timeout silently
+        import faulthandler
+        faulthandler.dump_traceback_later(args.hang_dump, exit=True)
     if args.wallclock:
         return wallclock_main(args)
 
-    api, mgr = make_control_plane(cache=not args.no_cache)
+    api, mgr = make_control_plane(cache=not args.no_cache,
+                                  global_lock=args.global_lock)
 
     # fake fleet: enough hosts for every requested slice
     pools = []

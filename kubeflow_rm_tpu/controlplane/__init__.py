@@ -19,13 +19,16 @@ from kubeflow_rm_tpu.controlplane.apiserver import APIServer
 
 def make_control_plane(clock=None, *, auto_ready: bool = True,
                        enable_culling: bool = False,
-                       culler_config=None, cache: bool = True):
+                       culler_config=None, cache: bool = True,
+                       global_lock: bool = False):
     """Build (api, manager) with every controller and webhook wired.
 
     ``clock`` is injectable for deterministic culling tests;
     ``auto_ready=False`` leaves scheduled pods un-Ready for status tests;
     ``cache=False`` runs the manager on the raw verb surface (the A/B
-    baseline arm of ``spawn_conformance --no-cache``).
+    baseline arm of ``spawn_conformance --no-cache``);
+    ``global_lock=True`` restores the pre-r08 single-RLock apiserver
+    with synchronous watch delivery (the ``--global-lock`` A/B arm).
     """
     from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
     from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
@@ -60,7 +63,8 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
         TpuInjectWebhook,
     )
 
-    api = APIServer(**({"clock": clock} if clock else {}))
+    api = APIServer(global_lock=global_lock,
+                    **({"clock": clock} if clock else {}))
     api.register_validator(nb_api.KIND, nb_api.validate)
     api.register_validator(pd_api.KIND, pd_api.validate)
 

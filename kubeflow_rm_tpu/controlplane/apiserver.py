@@ -16,11 +16,11 @@ injected (``clock``) so culling/idleness tests are deterministic.
 from __future__ import annotations
 
 import collections
+import contextlib
 import copy
 import datetime
 import fnmatch
-import functools
-import json
+import logging
 import threading
 import time
 from typing import Callable
@@ -76,45 +76,159 @@ def _utcnow() -> datetime.datetime:
 _fastcopy = fast_deepcopy
 
 
-def _synchronized(fn):
-    """Serialize a verb on the store lock. The real apiserver runs
-    writes through etcd transactions; here a reentrant lock gives the
-    same guarantee the Conflict check needs (read-compare-write of
-    resourceVersion is atomic) once callers are multithreaded — the
-    REST facade's ThreadingHTTPServer and the parallel Manager both
-    are. Reentrant because verbs nest (patch→update,
-    delete→_finalize_delete→garbage-collect→delete). Watchers fire
-    under the lock, in rv order; they must stay non-blocking (ours
-    enqueue and return)."""
-    @functools.wraps(fn)
-    def wrapper(self, *a, **k):
-        with self._lock:
-            return fn(self, *a, **k)
-    return wrapper
+log = logging.getLogger("kubeflow_rm_tpu.apiserver")
+
+# event type delivered to a watcher whose fanout queue overflowed: the
+# dropped window cannot be replayed, so the watcher must relist (the
+# same contract as a kube watch 410 Gone — cache/informer.py and the
+# REST facade both turn it into their existing relist paths)
+TOO_OLD = "TOO_OLD"
+
+_NULL_CTX = contextlib.nullcontext()
+_EMPTY: dict = {}
+
+
+class _WatcherChannel:
+    """Bounded per-watcher FIFO drained by a dedicated dispatch thread.
+
+    ``publish`` never blocks and never runs the callback — writers are
+    decoupled from watch delivery entirely. Ordered delivery per
+    watcher is preserved (one FIFO, one drainer). On overflow the
+    backlog is dropped wholesale and a single ``TOO_OLD`` sentinel is
+    queued, forcing the watcher through its relist recovery path.
+    The dispatch thread is started lazily and exits after a few idle
+    seconds so short-lived apiservers (tests build hundreds) don't
+    accumulate parked threads."""
+
+    IDLE_EXIT_S = 5.0
+
+    def __init__(self, fn: Callable, maxlen: int, name: str):
+        self.fn = fn
+        self.name = name
+        self.maxlen = maxlen
+        self._q: collections.deque = collections.deque()
+        self._cond = threading.Condition(threading.Lock())
+        self._thread: threading.Thread | None = None
+        self._busy = False  # a callback is in flight
+        self.overflows = 0
+        self.delivered = 0
+        from kubeflow_rm_tpu.controlplane import metrics
+        self._m_depth = metrics.WATCH_FANOUT_QUEUE_DEPTH.labels(
+            watcher=name)
+        self._m_overflow = metrics.WATCH_FANOUT_OVERFLOWS_TOTAL.labels(
+            watcher=name)
+        self._m_delivered = metrics.WATCH_FANOUT_DELIVERED_TOTAL.labels(
+            watcher=name)
+        self._m_lag = metrics.WATCH_FANOUT_DISPATCH_LAG.labels(
+            watcher=name)
+
+    def publish(self, item: tuple) -> None:
+        with self._cond:
+            if len(self._q) >= self.maxlen:
+                # drop the whole window: partial delivery after a gap
+                # would be indistinguishable from ordered delivery
+                self._q.clear()
+                self.overflows += 1
+                self._m_overflow.inc()
+                self._q.append((TOO_OLD, {}, None, time.monotonic()))
+            else:
+                self._q.append(item)
+            self._m_depth.set(len(self._q))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"watch-fanout-{self.name}")
+                self._thread.start()
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q:
+                    if not self._cond.wait(timeout=self.IDLE_EXIT_S) \
+                            and not self._q:
+                        self._thread = None
+                        return
+                item = self._q.popleft()
+                self._m_depth.set(len(self._q))
+                self._busy = True
+            etype, obj, old, t_enq = item
+            try:
+                self.fn(etype, obj, old)
+            except Exception:  # noqa: BLE001 - a watcher must not
+                log.exception("watcher %s raised", self.name)  # kill fanout
+            finally:
+                self.delivered += 1
+                self._m_delivered.inc()
+                self._m_lag.set(time.monotonic() - t_enq)
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def idle(self) -> bool:
+        with self._cond:
+            return not self._q and not self._busy
+
+    def drain(self, deadline: float) -> bool:
+        """Block until every event queued so far has been delivered
+        (queue empty AND no callback in flight)."""
+        with self._cond:
+            while self._q or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
 
 
 class APIServer:
-    def __init__(self, clock: Callable[[], datetime.datetime] = _utcnow):
+    def __init__(self, clock: Callable[[], datetime.datetime] = _utcnow,
+                 *, global_lock: bool = False,
+                 watch_queue_maxlen: int = 4096):
         self.clock = clock
-        self._lock = threading.RLock()
-        self._store: dict[tuple[str, str | None, str], dict] = {}
-        # per-kind secondary index (kind -> {full key: obj}) so list/
-        # scan iterate only the requested kind instead of every object
-        # of every kind under the verb lock — at 20-way spawn scale the
-        # flat walk made Pod lists O(all events + pods + leases + ...)
+        # ---- locking model ------------------------------------------
+        # Sharded (default): one RLock PER KIND serializes writes to
+        # that kind (the Conflict read-compare-write and rv ordering
+        # within a kind stay atomic), a separate atomic counter hands
+        # out resourceVersions, and reads come from copy-on-write
+        # per-kind snapshots WITHOUT any lock — so a Pod list never
+        # waits on an Event write and vice versa. Locks are reentrant
+        # because verbs nest (patch→update, delete→_finalize_delete→
+        # garbage-collect→delete); cross-kind nesting follows the
+        # ownerReference DAG (owner's kind lock held while dependents'
+        # are taken), which is acyclic for every object graph the
+        # platform builds.
+        #
+        # ``global_lock=True`` restores the pre-r08 model — ONE
+        # reentrant lock around every verb, watchers fired
+        # synchronously inside the write path — as the A/B baseline
+        # arm (`spawn_conformance --global-lock`).
+        self._global = global_lock
+        self._lock = threading.RLock()  # the global-arm verb lock
+        self._locks: dict[str, threading.RLock] = {}
+        self._locks_guard = threading.Lock()
+        self._rv_lock = threading.Lock()
+        self._seq_lock = threading.Lock()
+        self._watch_queue_maxlen = watch_queue_maxlen
+        # per-kind working dicts (kind -> {full key: obj}) — mutated
+        # only under that kind's lock — plus the published COW
+        # snapshots reads iterate lock-free (sharded mode only)
         self._by_kind: dict[str, dict[tuple, dict]] = {}
+        self._snap: dict[str, dict[tuple, dict]] = {}
         self._rv = 0
         # admission plugins: fn(op, obj, old) -> obj | None (op: CREATE/UPDATE)
         self._admission: list[tuple[str, Callable]] = []
         # validators per kind: fn(obj) raising on bad spec (CRD schema stand-in)
         self._validators: dict[str, Callable[[dict], None]] = {}
         self._watchers: list[Callable[[str, dict, dict | None], None]] = []
+        self._channels: list[_WatcherChannel] = []
         self._event_seq = 0
         self.quota_enforcement = True
         # container stdout per pod (the kubelet's log store; the fake
         # kubelet appends boot lines, the `pods/<name>/log` subresource
         # reads them — ref jupyter backend get_pod_logs)
         self._pod_logs: dict[tuple[str, str], list[str]] = {}
+        self._pod_log_lock = threading.Lock()
         # bounded audit trail of writes, tagged with the writer identity
         # set via set_writer (the REST facade stamps it from the
         # X-Writer-Identity header). The failover conformance asserts
@@ -122,6 +236,7 @@ class APIServer:
         # write lands, the dead leader must never write again.
         self.write_log: collections.deque = collections.deque(maxlen=8192)
         self._write_seq = 0
+        self._write_lock = threading.Lock()
         self._writer = threading.local()
 
     # ---- wiring ------------------------------------------------------
@@ -133,8 +248,37 @@ class APIServer:
     def register_validator(self, kind: str, fn: Callable[[dict], None]) -> None:
         self._validators[kind] = fn
 
-    def add_watcher(self, fn: Callable[[str, dict, dict | None], None]) -> None:
+    def add_watcher(self, fn: Callable[[str, dict, dict | None], None],
+                    name: str | None = None) -> None:
+        """Subscribe to store events. Sharded mode delivers them
+        asynchronously (ordered per watcher) off a bounded FIFO; a
+        watcher that falls behind gets a ``TOO_OLD`` event and must
+        relist. ``name`` labels the fanout gauges."""
         self._watchers.append(fn)
+        if not self._global:
+            self._channels.append(_WatcherChannel(
+                fn, self._watch_queue_maxlen,
+                name or f"watcher-{len(self._channels)}"))
+
+    def drain_watchers(self, timeout: float = 30.0) -> bool:
+        """Barrier: block until every event emitted so far has been
+        delivered to every watcher. Deterministic tests and
+        ``Manager.run_until_idle`` call this so async fanout never
+        races a readiness assertion. No-op (True) in global-lock mode,
+        where delivery is synchronous."""
+        deadline = time.monotonic() + timeout
+        # one delivered event can enqueue follow-on events for another
+        # channel only through a write, and watchers never write — but
+        # a TOO_OLD relist repopulates stores, so settle until every
+        # channel is simultaneously idle
+        while True:
+            ok = all(ch.drain(deadline) for ch in list(self._channels))
+            if not ok:
+                return False
+            if all(ch.idle() for ch in self._channels):
+                return True
+            if time.monotonic() > deadline:
+                return False
 
     # ---- helpers -----------------------------------------------------
     def _key(self, kind: str, name: str, namespace: str | None):
@@ -142,9 +286,42 @@ class APIServer:
             return (kind, None, name)
         return (kind, namespace, name)
 
+    def _kind_lock(self, kind: str) -> threading.RLock:
+        """The write lock for ``kind`` (the one global lock in the
+        legacy arm)."""
+        if self._global:
+            return self._lock
+        lk = self._locks.get(kind)
+        if lk is None:
+            with self._locks_guard:
+                lk = self._locks.setdefault(kind, threading.RLock())
+        return lk
+
+    def _read_lock(self):
+        """Reads are lock-free against COW snapshots in sharded mode;
+        the legacy arm serializes them on the verb lock as before."""
+        return self._lock if self._global else _NULL_CTX
+
+    def _view(self, kind: str) -> dict:
+        """The mapping a read of ``kind`` iterates: the published COW
+        snapshot (sharded — safe without any lock, never mutated after
+        publication) or the live working dict (global arm — callers
+        hold the verb lock)."""
+        return (self._by_kind if self._global else self._snap).get(
+            kind, _EMPTY)
+
+    def _publish(self, kind: str) -> None:
+        """Publish a fresh immutable snapshot of ``kind`` (caller holds
+        the kind lock). Shallow copy: stored objects are replaced, not
+        mutated, on update — so an old snapshot stays internally
+        consistent for readers mid-iteration."""
+        if not self._global:
+            self._snap[kind] = dict(self._by_kind.get(kind, _EMPTY))
+
     def _next_rv(self) -> str:
-        self._rv += 1
-        return str(self._rv)
+        with self._rv_lock:
+            self._rv += 1
+            return str(self._rv)
 
     def set_writer(self, identity: str | None) -> None:
         """Tag subsequent writes from THIS thread with ``identity`` in
@@ -153,27 +330,37 @@ class APIServer:
         self._writer.identity = identity
 
     def _log_write(self, verb: str, obj: dict) -> None:
-        self._write_seq += 1
-        self.write_log.append({
-            "seq": self._write_seq,
-            "rv": int(obj["metadata"].get("resourceVersion") or 0),
-            "verb": verb,
-            "kind": obj["kind"],
-            "namespace": namespace_of(obj),
-            "name": name_of(obj),
-            "writer": getattr(self._writer, "identity", None),
-            "t": time.time(),
-        })
+        with self._write_lock:
+            self._write_seq += 1
+            self.write_log.append({
+                "seq": self._write_seq,
+                "rv": int(obj["metadata"].get("resourceVersion") or 0),
+                "verb": verb,
+                "kind": obj["kind"],
+                "namespace": namespace_of(obj),
+                "name": name_of(obj),
+                "writer": getattr(self._writer, "identity", None),
+                "t": time.time(),
+            })
 
     def _emit(self, event: str, obj: dict, old: dict | None = None) -> None:
         # ONE defensive copy shared by all watchers — the watcher
-        # contract is read-only + non-blocking (Manager._on_event
-        # enqueues, RestServer._on_event serializes); per-watcher
-        # deepcopies measurably dominated the 20-way spawn event storm
+        # contract is read-only; per-watcher deepcopies measurably
+        # dominated the 20-way spawn event storm. Sharded mode only
+        # ENQUEUES here (still under the kind lock, so per-kind order
+        # per watcher matches rv order) and a dedicated thread per
+        # watcher delivers — a slow or blocked watcher can no longer
+        # hold the write path. The legacy arm fires synchronously
+        # inside the verb, as before r08.
         obj_c = _fastcopy(obj)
         old_c = _fastcopy(old) if old else None
-        for w in list(self._watchers):
-            w(event, obj_c, old_c)
+        if self._global:
+            for w in list(self._watchers):
+                w(event, obj_c, old_c)
+            return
+        t = time.monotonic()
+        for ch in self._channels:
+            ch.publish((event, obj_c, old_c, t))
 
     def _run_admission(self, op: str, obj: dict, old: dict | None) -> dict:
         for pattern, fn in self._admission:
@@ -183,57 +370,57 @@ class APIServer:
                     obj = result
         return obj
 
-    @_synchronized
     def ensure_namespace(self, namespace: str) -> dict:
-        try:
-            return self.get("Namespace", namespace)
-        except NotFound:
-            return self.create({"apiVersion": "v1", "kind": "Namespace",
-                                "metadata": {"name": namespace}})
+        with self._kind_lock("Namespace"):
+            try:
+                return self.get("Namespace", namespace)
+            except NotFound:
+                return self.create({"apiVersion": "v1", "kind": "Namespace",
+                                    "metadata": {"name": namespace}})
 
     # ---- verbs -------------------------------------------------------
-    @_synchronized
     def create(self, obj: dict) -> dict:
         obj = _fastcopy(obj)
         kind = obj["kind"]
         name, ns = name_of(obj), namespace_of(obj)
-        if kind in CLUSTER_SCOPED_KINDS:
-            ns = None
-            obj["metadata"].pop("namespace", None)
-        elif ns is None:
-            raise Invalid(f"{kind}/{name}: namespaced kind requires namespace")
-        else:
-            if ("Namespace", None, ns) not in self._store:
-                raise NotFound(f"namespace {ns!r} not found")
-        key = self._key(kind, name, ns)
-        if key in self._store:
-            raise AlreadyExists(f"{kind} {ns}/{name} already exists")
-        if kind in self._validators:
-            try:
-                self._validators[kind](obj)
-            except Exception as e:
-                raise Invalid(f"{kind} {ns}/{name}: {e}") from e
-        obj = self._run_admission("CREATE", obj, None)
-        if self.quota_enforcement and kind == "Pod":
-            self._enforce_quota(obj)
-        meta = obj["metadata"]
-        meta["uid"] = new_uid()
-        meta["resourceVersion"] = self._next_rv()
-        meta["creationTimestamp"] = self.clock().isoformat()
-        self._store[key] = obj
-        self._by_kind.setdefault(kind, {})[key] = obj
-        self._log_write("CREATE", obj)
-        self._emit("ADDED", obj)
-        return _fastcopy(obj)
+        with self._kind_lock(kind):
+            if kind in CLUSTER_SCOPED_KINDS:
+                ns = None
+                obj["metadata"].pop("namespace", None)
+            elif ns is None:
+                raise Invalid(
+                    f"{kind}/{name}: namespaced kind requires namespace")
+            else:
+                if ("Namespace", None, ns) not in self._view("Namespace"):
+                    raise NotFound(f"namespace {ns!r} not found")
+            key = self._key(kind, name, ns)
+            if key in self._by_kind.get(kind, _EMPTY):
+                raise AlreadyExists(f"{kind} {ns}/{name} already exists")
+            if kind in self._validators:
+                try:
+                    self._validators[kind](obj)
+                except Exception as e:
+                    raise Invalid(f"{kind} {ns}/{name}: {e}") from e
+            obj = self._run_admission("CREATE", obj, None)
+            if self.quota_enforcement and kind == "Pod":
+                self._enforce_quota(obj)
+            meta = obj["metadata"]
+            meta["uid"] = new_uid()
+            meta["resourceVersion"] = self._next_rv()
+            meta["creationTimestamp"] = self.clock().isoformat()
+            self._by_kind.setdefault(kind, {})[key] = obj
+            self._publish(kind)
+            self._log_write("CREATE", obj)
+            self._emit("ADDED", obj)
+            return _fastcopy(obj)
 
-    @_synchronized
     def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
-        key = self._key(kind, name, namespace)
-        if key not in self._store:
-            raise NotFound(f"{kind} {namespace}/{name} not found")
-        return _fastcopy(self._store[key])
+        with self._read_lock():
+            obj = self._view(kind).get(self._key(kind, name, namespace))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return _fastcopy(obj)
 
-    @_synchronized
     def try_get(self, kind: str, name: str,
                 namespace: str | None = None) -> dict | None:
         try:
@@ -241,21 +428,20 @@ class APIServer:
         except NotFound:
             return None
 
-    @_synchronized
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict | None = None) -> list[dict]:
         out = []
-        for (_, ns, _), obj in self._by_kind.get(kind, {}).items():
-            if namespace is not None and ns != namespace:
-                continue
-            if label_selector and not matches_selector(
-                    labels_of(obj), label_selector):
-                continue
-            out.append(_fastcopy(obj))
+        with self._read_lock():
+            for (_, ns, _), obj in self._view(kind).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not matches_selector(
+                        labels_of(obj), label_selector):
+                    continue
+                out.append(_fastcopy(obj))
         out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
         return out
 
-    @_synchronized
     def scan(self, kind: str, namespace: str | None = None) -> list[dict]:
         """READ-ONLY ``list``: returns live store references WITHOUT
         copying. For in-process consumers on hot paths (the fake
@@ -265,91 +451,105 @@ class APIServer:
         mutate the returned objects; mutate a ``get()`` copy and write
         it back through ``update``. Remote adapters don't have this
         method — use ``getattr(api, "scan", api.list)``."""
-        return [o for (_, ns, _), o in self._by_kind.get(kind, {}).items()
-                if namespace is None or ns == namespace]
+        with self._read_lock():
+            return [o for (_, ns, _), o in self._view(kind).items()
+                    if namespace is None or ns == namespace]
 
-    @_synchronized
     def update(self, obj: dict) -> dict:
         obj = _fastcopy(obj)
         kind, name, ns = obj["kind"], name_of(obj), namespace_of(obj)
         if kind in CLUSTER_SCOPED_KINDS:
             ns = None
         key = self._key(kind, name, ns)
-        if key not in self._store:
-            raise NotFound(f"{kind} {ns}/{name} not found")
-        old = self._store[key]
-        rv = obj["metadata"].get("resourceVersion")
-        if rv is not None and rv != old["metadata"]["resourceVersion"]:
-            raise Conflict(
-                f"{kind} {ns}/{name}: resourceVersion {rv} != "
-                f"{old['metadata']['resourceVersion']}"
-            )
-        if kind in self._validators:
-            try:
-                self._validators[kind](obj)
-            except Exception as e:
-                raise Invalid(f"{kind} {ns}/{name}: {e}") from e
-        obj = self._run_admission("UPDATE", obj, _fastcopy(old))
-        # immutable fields
-        obj["metadata"]["uid"] = old["metadata"]["uid"]
-        obj["metadata"]["creationTimestamp"] = old["metadata"]["creationTimestamp"]
-        if old["metadata"].get("deletionTimestamp"):
-            obj["metadata"]["deletionTimestamp"] = \
-                old["metadata"]["deletionTimestamp"]
-        obj["metadata"]["resourceVersion"] = self._next_rv()
-        self._store[key] = obj
-        self._by_kind.setdefault(kind, {})[key] = obj
-        self._log_write("UPDATE", obj)
-        # a deleting object whose finalizers have all been removed goes away
-        if obj["metadata"].get("deletionTimestamp") and \
-                not obj["metadata"].get("finalizers"):
-            return self._finalize_delete(key)
-        self._emit("MODIFIED", obj, old)
-        return _fastcopy(obj)
+        with self._kind_lock(kind):
+            working = self._by_kind.get(kind, _EMPTY)
+            if key not in working:
+                raise NotFound(f"{kind} {ns}/{name} not found")
+            old = working[key]
+            rv = obj["metadata"].get("resourceVersion")
+            if rv is not None and rv != old["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"{kind} {ns}/{name}: resourceVersion {rv} != "
+                    f"{old['metadata']['resourceVersion']}"
+                )
+            if kind in self._validators:
+                try:
+                    self._validators[kind](obj)
+                except Exception as e:
+                    raise Invalid(f"{kind} {ns}/{name}: {e}") from e
+            obj = self._run_admission("UPDATE", obj, _fastcopy(old))
+            # immutable fields
+            obj["metadata"]["uid"] = old["metadata"]["uid"]
+            obj["metadata"]["creationTimestamp"] = \
+                old["metadata"]["creationTimestamp"]
+            if old["metadata"].get("deletionTimestamp"):
+                obj["metadata"]["deletionTimestamp"] = \
+                    old["metadata"]["deletionTimestamp"]
+            obj["metadata"]["resourceVersion"] = self._next_rv()
+            working[key] = obj
+            self._publish(kind)
+            self._log_write("UPDATE", obj)
+            # a deleting object whose finalizers have all been removed
+            # goes away
+            if obj["metadata"].get("deletionTimestamp") and \
+                    not obj["metadata"].get("finalizers"):
+                return self._finalize_delete(key)
+            self._emit("MODIFIED", obj, old)
+            return _fastcopy(obj)
 
-    @_synchronized
     def patch(self, kind: str, name: str, patch: dict,
               namespace: str | None = None) -> dict:
-        current = self.get(kind, name, namespace)
-        merged = strategic_merge(current, patch)
-        merged["metadata"]["resourceVersion"] = \
-            current["metadata"]["resourceVersion"]
-        return self.update(merged)
+        with self._kind_lock(kind):
+            current = self.get(kind, name, namespace)
+            merged = strategic_merge(current, patch)
+            merged["metadata"]["resourceVersion"] = \
+                current["metadata"]["resourceVersion"]
+            return self.update(merged)
 
-    @_synchronized
     def update_status(self, obj: dict) -> dict:
         """Status-subresource write: only ``status`` is applied."""
-        current = self.get(obj["kind"], name_of(obj), namespace_of(obj))
-        current["status"] = _fastcopy(obj.get("status", {}))
-        return self.update(current)
+        with self._kind_lock(obj["kind"]):
+            current = self.get(obj["kind"], name_of(obj),
+                               namespace_of(obj))
+            current["status"] = _fastcopy(obj.get("status", {}))
+            return self.update(current)
 
-    @_synchronized
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
         key = self._key(kind, name, namespace)
-        if key not in self._store:
-            raise NotFound(f"{kind} {namespace}/{name} not found")
-        obj = self._store[key]
-        if obj["metadata"].get("finalizers"):
-            if not obj["metadata"].get("deletionTimestamp"):
-                obj["metadata"]["deletionTimestamp"] = self.clock().isoformat()
-                obj["metadata"]["resourceVersion"] = self._next_rv()
-                self._log_write("UPDATE", obj)
-                self._emit("MODIFIED", obj)
-            return
-        self._finalize_delete(key)
+        with self._kind_lock(kind):
+            working = self._by_kind.get(kind, _EMPTY)
+            if key not in working:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            obj = working[key]
+            if obj["metadata"].get("finalizers"):
+                if not obj["metadata"].get("deletionTimestamp"):
+                    # replace, don't mutate in place: published
+                    # snapshots share the stored reference and lock-
+                    # free readers must never see a half-written object
+                    obj = _fastcopy(obj)
+                    obj["metadata"]["deletionTimestamp"] = \
+                        self.clock().isoformat()
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
+                    working[key] = obj
+                    self._publish(kind)
+                    self._log_write("UPDATE", obj)
+                    self._emit("MODIFIED", obj)
+                return
+            self._finalize_delete(key)
 
-    @_synchronized
     def append_pod_log(self, namespace: str, pod_name: str,
                        line: str) -> None:
-        self._pod_logs.setdefault((namespace, pod_name), []).append(line)
+        with self._pod_log_lock:
+            self._pod_logs.setdefault(
+                (namespace, pod_name), []).append(line)
 
-    @_synchronized
     def pod_logs(self, namespace: str, pod_name: str,
                  tail_lines: int | None = None) -> str:
         """Stored container stdout for a pod (kube ``pods/.../log``).
         Raises NotFound for a pod that does not exist."""
         self.get("Pod", pod_name, namespace)
-        lines = self._pod_logs.get((namespace, pod_name), [])
+        with self._pod_log_lock:
+            lines = list(self._pod_logs.get((namespace, pod_name), ()))
         if tail_lines is not None:
             if tail_lines < 0:
                 raise Invalid(f"tailLines must be >= 0, got {tail_lines}")
@@ -357,52 +557,71 @@ class APIServer:
         return "".join(f"{line}\n" for line in lines)
 
     def _finalize_delete(self, key) -> dict:
-        obj = self._store.pop(key)
-        self._by_kind.get(key[0], {}).pop(key, None)
+        """Caller holds ``key``'s kind lock."""
+        kind = key[0]
+        obj = self._by_kind.get(kind, _EMPTY).pop(key)
+        self._publish(kind)
         self._log_write("DELETE", obj)
         if obj["kind"] == "Pod":
-            self._pod_logs.pop(
-                (namespace_of(obj) or "default", name_of(obj)), None)
+            with self._pod_log_lock:
+                self._pod_logs.pop(
+                    (namespace_of(obj) or "default", name_of(obj)), None)
         self._emit("DELETED", obj)
         self._garbage_collect(obj)
         if obj["kind"] == "Namespace":
             # namespace deletion drains everything inside it
             ns = name_of(obj)
-            for (kind, kns, name) in [k for k in self._store if k[1] == ns]:
+            doomed = []
+            with self._read_lock():
+                for k, snapmap in list(
+                        (self._by_kind if self._global
+                         else self._snap).items()):
+                    doomed.extend(kk for kk in snapmap if kk[1] == ns)
+            for (kkind, kns, kname) in doomed:
                 try:
-                    self.delete(kind, name, kns)
+                    self.delete(kkind, kname, kns)
                 except NotFound:
                     pass
         return _fastcopy(obj)
 
     def _garbage_collect(self, owner: dict) -> None:
-        """Cascade-delete dependents referencing the deleted owner's uid."""
+        """Cascade-delete dependents referencing the deleted owner's
+        uid. Lock acquisition follows the ownerReference DAG (the
+        owner's kind lock is held while each dependent's is taken) —
+        acyclic for every graph the platform builds, and the CI
+        contention-stress step runs with a faulthandler hang dump so a
+        future cycle fails fast instead of deadlocking silently."""
         owner_uid = owner["metadata"].get("uid")
         if not owner_uid:
             return
-        dependents = [
-            (k, obj) for k, obj in list(self._store.items())
-            if any(r.get("uid") == owner_uid
-                   for r in obj["metadata"].get("ownerReferences", []))
-        ]
-        for (kind, ns, name), _ in dependents:
+        dependents = []
+        with self._read_lock():
+            for kind, snapmap in list(
+                    (self._by_kind if self._global
+                     else self._snap).items()):
+                for k, obj in snapmap.items():
+                    if any(r.get("uid") == owner_uid for r in
+                           obj["metadata"].get("ownerReferences", [])):
+                        dependents.append(k)
+        for (kind, ns, name) in dependents:
             try:
                 self.delete(kind, name, ns)
             except NotFound:
                 pass
 
     # ---- events ------------------------------------------------------
-    @_synchronized
     def record_event(self, involved: dict, etype: str, reason: str,
                      message: str) -> dict:
         """Create a v1 Event for ``involved`` (controller event recorder)."""
-        self._event_seq += 1
+        with self._seq_lock:
+            self._event_seq += 1
+            seq = self._event_seq
         ns = namespace_of(involved) or "default"
         ev = {
             "apiVersion": "v1",
             "kind": "Event",
             "metadata": {
-                "name": f"{name_of(involved)}.{self._event_seq:08x}",
+                "name": f"{name_of(involved)}.{seq:08x}",
                 "namespace": ns,
             },
             "type": etype,
@@ -420,7 +639,6 @@ class APIServer:
         }
         return self.create(ev)
 
-    @_synchronized
     def events_for(self, involved: dict) -> list[dict]:
         ns = namespace_of(involved)
         return [
@@ -432,7 +650,6 @@ class APIServer:
     # ---- SubjectAccessReview (kube-apiserver authorization) ----------
     READ_VERBS = frozenset({"get", "list", "watch"})
 
-    @_synchronized
     def access_review(self, user: str | None, verb: str, resource: str,
                       namespace: str | None = None) -> bool:
         """Evaluate RBAC the way a SubjectAccessReview does: the web

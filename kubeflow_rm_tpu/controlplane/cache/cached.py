@@ -237,10 +237,26 @@ class CachedAPI:
 
     def delete(self, kind: str, name: str,
                namespace: str | None = None) -> None:
-        # the backend keeps the store honest: the in-memory server
-        # emits DELETED/MODIFIED synchronously, the kube adapter
-        # discards from its own (shared) store optimistically
-        return self.api.delete(kind, name, namespace)
+        out = self.api.delete(kind, name, namespace)
+        # read-your-writes: the in-memory server's DELETED/MODIFIED
+        # event arrives on the fanout thread, so reconcile the store
+        # from the backend's post-delete truth before returning — gone
+        # means discard, finalizer-pending means fold the
+        # deletionTimestamp. (The kube adapter feeds its own shared
+        # store and already discards optimistically in its delete.)
+        if not self.informer._backend_fed and self._serves(kind):
+            cur = self.api.try_get(kind, name, namespace)
+            if cur is None:
+                self.store.discard(kind, name, namespace)
+            else:
+                self._fold("MODIFIED", cur)
+        return out
+
+    def record_event(self, involved: dict, etype: str, reason: str,
+                     message: str) -> dict:
+        out = self.api.record_event(involved, etype, reason, message)
+        self._fold("ADDED", out)
+        return out
 
     # ---- conflict fast-path ------------------------------------------
     def _resolve_conflict(self, desired: dict) -> dict:

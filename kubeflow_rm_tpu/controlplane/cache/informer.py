@@ -4,10 +4,12 @@ controller-runtime starts one informer per watched type and shares it
 between every controller's cached client; this module is that object
 for both backends the platform runs against:
 
-- **in-memory ``APIServer``**: watcher callbacks fire synchronously
-  under the apiserver's verb lock, so the store is never stale — a
-  kind is primed lazily (one ``list`` on first read) and every later
-  event keeps it exact. No threads.
+- **in-memory ``APIServer``**: events arrive (ordered, per-kind rv
+  order) on the apiserver's fanout dispatch thread — a kind is primed
+  lazily (one ``list`` on first read) and the store's rv monotonicity
+  plus the relist-merge horizon keep concurrent event delivery and
+  priming from ever rolling the cache back. A ``TOO_OLD`` overflow
+  sentinel forces a relist of every synced kind (the 410 path).
 - **``KubeAPIServer``**: the adapter's ``watch_kind`` loops own the
   transport (list+watch with rv resume, full relist on 410 Gone) and
   feed the adapter's ``ObjectStore``; the informer adopts that store,
@@ -44,16 +46,28 @@ class SharedInformer:
         else:
             self.store = store or ObjectStore()
             self._backend_fed = False
-            api.add_watcher(self._on_event)
-        # lazy priming is only sound when events are synchronous with
-        # verbs (the in-memory backend); a remote backend must sync
-        # through its watch threads
+            api.add_watcher(self._on_event, name="informer")
+        # lazy priming is only sound against the in-memory backend,
+        # whose list() is exact at call time (events racing the prime
+        # are reconciled by replace()'s rv horizon); a remote backend
+        # must sync through its watch threads
         self.lazy = not hasattr(api, "watch_kind")
         self._prime_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
 
     # ---- event feed (in-memory backend) ------------------------------
     def _on_event(self, etype: str, obj: dict, old: dict | None) -> None:
+        if etype == "TOO_OLD":
+            # the apiserver's fanout queue overflowed for this watcher:
+            # the event window is gone, so relist every synced kind —
+            # the same recovery a kube watch 410 forces, reusing the
+            # store's relist-merge (rv horizon keeps later events sane)
+            for kind in self.store.synced_kinds():
+                try:
+                    self.store.replace(kind, self.api.list(kind))
+                except Exception:  # noqa: BLE001 - kind vanished mid-relist
+                    log.exception("TOO_OLD relist of %s failed", kind)
+            return
         self.store.apply(etype, obj)
         from kubeflow_rm_tpu.controlplane import metrics
         kind = obj.get("kind")

@@ -310,14 +310,36 @@ class _FastSession:
 
 
 def _selector_param(label_selector: dict | None) -> dict:
+    """Serialize a structured LabelSelector to the query-string grammar
+    (the inverse of the REST facade's ``_selector_from``): matchLabels
+    as ``k=v`` and matchExpressions as ``k!=v`` / ``k`` / ``!k`` /
+    ``k in (a,b)`` / ``k notin (a,b)``."""
     if not label_selector:
         return {}
-    if "matchLabels" in label_selector:
-        pairs = label_selector["matchLabels"]
+    if "matchLabels" in label_selector or \
+            "matchExpressions" in label_selector:
+        pairs = label_selector.get("matchLabels") or {}
+        exprs = label_selector.get("matchExpressions") or []
     else:
-        pairs = label_selector
-    return {"labelSelector": ",".join(
-        f"{k}={v}" for k, v in sorted(pairs.items()))}
+        pairs, exprs = label_selector, []
+    reqs = [f"{k}={v}" for k, v in sorted(pairs.items())]
+    for e in exprs:
+        key, op = e["key"], e["operator"]
+        values = sorted(e.get("values") or [])
+        if op == "In":
+            reqs.append(f"{key} in ({','.join(values)})")
+        elif op == "NotIn":
+            if len(values) == 1:
+                reqs.append(f"{key}!={values[0]}")
+            else:
+                reqs.append(f"{key} notin ({','.join(values)})")
+        elif op == "Exists":
+            reqs.append(key)
+        elif op == "DoesNotExist":
+            reqs.append(f"!{key}")
+        else:
+            raise Invalid(f"unknown selector operator {op!r}")
+    return {"labelSelector": ",".join(reqs)}
 
 
 class KubeAPIServer:
@@ -431,7 +453,10 @@ class KubeAPIServer:
         log.debug("validation for %s is the CRD schema's job in-cluster",
                   kind)
 
-    def add_watcher(self, fn: Callable[[str, dict, dict | None], None]) -> None:
+    def add_watcher(self, fn: Callable[[str, dict, dict | None], None],
+                    name: str | None = None) -> None:
+        # ``name`` labels in-memory fanout gauges; the adapter's watch
+        # threads deliver synchronously, so it's accepted and unused
         self._watchers.append(fn)
 
     # ---- URL plumbing ------------------------------------------------

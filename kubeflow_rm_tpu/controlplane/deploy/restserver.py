@@ -60,16 +60,66 @@ def _status(code: int, reason: str, message: str) -> dict:
             "code": code, "reason": reason, "message": message}
 
 
+def _split_selector(raw: str) -> list[str]:
+    """Split on commas OUTSIDE parentheses — ``k in (a,b),x=y`` is two
+    requirements, not three."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(raw):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        elif ch == "," and depth == 0:
+            parts.append(raw[start:i])
+            start = i + 1
+    parts.append(raw[start:])
+    return [p.strip() for p in parts if p.strip()]
+
+
 def _selector_from(params: dict) -> dict | None:
+    """Parse a kube labelSelector query string into the structured
+    LabelSelector ``matches_selector`` evaluates. Full requirement
+    grammar: ``k=v``/``k==v`` (equality), ``k!=v`` (NotIn — previously
+    misparsed as an equality match on the key ``k!``), bare ``k``
+    (Exists), ``!k`` (DoesNotExist), ``k in (a,b)`` / ``k notin (a,b)``
+    (set forms)."""
     raw = params.get("labelSelector", [None])[0]
     if not raw:
         return None
-    pairs = {}
-    for part in raw.split(","):
-        if "=" in part:
-            k, _, v = part.partition("=")
-            pairs[k.removesuffix("=")] = v
-    return {"matchLabels": pairs}
+    pairs: dict[str, str] = {}
+    exprs: list[dict] = []
+    for part in _split_selector(raw):
+        low = part.lower()
+        if " notin (" in low:
+            idx = low.index(" notin (")
+            vals = part[idx + len(" notin ("):].rstrip(")").strip()
+            exprs.append({"key": part[:idx].strip(), "operator": "NotIn",
+                          "values": [v.strip() for v in vals.split(",")
+                                     if v.strip()]})
+        elif " in (" in low:
+            idx = low.index(" in (")
+            vals = part[idx + len(" in ("):].rstrip(")").strip()
+            exprs.append({"key": part[:idx].strip(), "operator": "In",
+                          "values": [v.strip() for v in vals.split(",")
+                                     if v.strip()]})
+        elif "!=" in part:
+            k, _, v = part.partition("!=")
+            exprs.append({"key": k.strip(), "operator": "NotIn",
+                          "values": [v.strip()]})
+        elif "=" in part:
+            k, _, v = part.partition("==" if "==" in part else "=")
+            pairs[k.strip()] = v.strip()
+        elif part.startswith("!"):
+            exprs.append({"key": part[1:].strip(),
+                          "operator": "DoesNotExist"})
+        else:
+            exprs.append({"key": part, "operator": "Exists"})
+    out: dict = {}
+    if pairs:
+        out["matchLabels"] = pairs
+    if exprs:
+        out["matchExpressions"] = exprs
+    return out or None
 
 
 class _Route:
@@ -135,9 +185,26 @@ class RestServer:
         # (the informer then relists — kubeclient.watch_kind)
         self._backlog_floor = 0
         self._watch_lock = threading.Lock()
-        api.add_watcher(self._on_event)
+        api.add_watcher(self._on_event, name="rest")
 
     def _on_event(self, etype: str, obj: dict, old) -> None:
+        if etype == "TOO_OLD":
+            # our fanout queue overflowed upstream: an unknown window of
+            # events never reached this facade, so the backlog has a
+            # hole in it. Drop it, raise the horizon to the server's
+            # current rv, and 410 every open stream — exactly what a
+            # kube watch cache does when a client falls off its window.
+            with self._watch_lock:
+                self._backlog.clear()
+                self._backlog_floor = max(
+                    self._backlog_floor,
+                    int(getattr(self.api, "_rv", 0) or 0))
+                gone = {"type": "ERROR", "object": _status(
+                    410, "Expired",
+                    "watch window lost (fanout overflow); relist")}
+                for _, q in self._watch_queues:
+                    q.put((gone, None))
+            return
         evt = {"type": {"ADDED": "ADDED",
                         "MODIFIED": "MODIFIED",
                         "DELETED": "DELETED"}.get(etype, etype),
@@ -146,13 +213,18 @@ class RestServer:
             rv = int((obj.get("metadata") or {}).get("resourceVersion", 0))
         except (TypeError, ValueError):
             rv = 0
+        # encode ONCE; every subscriber of this kind (and any backlog
+        # replay) shares the same bytes — under a 20-way spawn storm
+        # per-client json.dumps was per-event × per-stream CPU inside
+        # what used to be the write path
+        raw = json.dumps(evt).encode() + b"\n"
         with self._watch_lock:
             if len(self._backlog) == self._backlog.maxlen:
                 self._backlog_floor = self._backlog[0][0]
-            self._backlog.append((rv, obj.get("kind"), evt))
+            self._backlog.append((rv, obj.get("kind"), evt, raw))
             for kind, q in self._watch_queues:
                 if obj.get("kind") == kind:
-                    q.put(evt)
+                    q.put((evt, raw))
 
     # ---- request handling -------------------------------------------
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
@@ -347,14 +419,14 @@ class RestServer:
             # since_rv below the backlog horizon cannot be replayed
             # faithfully -> 410 Gone ERROR event, client must relist.
             if since_rv and since_rv < self._backlog_floor:
-                q.put({"type": "ERROR", "object": _status(
+                q.put(({"type": "ERROR", "object": _status(
                     410, "Expired",
                     f"resourceVersion {since_rv} is too old "
-                    f"(horizon {self._backlog_floor})")})
+                    f"(horizon {self._backlog_floor})")}, None))
             elif since_rv:
-                for rv, kind, evt in self._backlog:
+                for rv, kind, evt, raw in self._backlog:
                     if kind == route.kind and rv > since_rv:
-                        q.put(evt)
+                        q.put((evt, raw))
             self._watch_queues.append((route.kind, q))
         timeout = float(params.get("timeoutSeconds", ["300"])[0])
         try:
@@ -375,7 +447,7 @@ class RestServer:
                 if remaining <= 0:
                     break
                 try:
-                    evt = q.get(timeout=min(remaining, 1.0))
+                    evt, raw = q.get(timeout=min(remaining, 1.0))
                 except queue.Empty:
                     continue
                 if evt.get("type") == "ERROR":
@@ -386,6 +458,12 @@ class RestServer:
                 if route.namespace and (
                         (evt["object"].get("metadata") or {})
                         .get("namespace")) != route.namespace:
+                    continue
+                if raw is not None and not self._needs_conversion(route):
+                    # shared single-encode buffer (the common case:
+                    # storage-version streams — every watcher of a kind
+                    # writes the exact same bytes)
+                    write_chunk(raw)
                     continue
                 # multi-version kinds: the stream speaks the version
                 # the client's path asked for (evt dicts are shared

@@ -142,6 +142,34 @@ INFORMER_LAST_EVENT_TIMESTAMP = Gauge(
     registry=REGISTRY,
 )
 
+# ---- watch fanout (apiserver async dispatch): queue health -----------
+WATCH_FANOUT_QUEUE_DEPTH = Gauge(
+    "watch_fanout_queue_depth",
+    "Events waiting in a watcher's fanout queue (kube-apiserver's "
+    "apiserver_watch_cache_events_dispatched analogue, per consumer)",
+    ["watcher"],
+    registry=REGISTRY,
+)
+WATCH_FANOUT_OVERFLOWS_TOTAL = Counter(
+    "watch_fanout_overflows_total",
+    "Times a watcher's bounded queue overflowed and was collapsed to a "
+    "TOO_OLD sentinel forcing that watcher to relist (410 Gone analogue)",
+    ["watcher"],
+    registry=REGISTRY,
+)
+WATCH_FANOUT_DELIVERED_TOTAL = Counter(
+    "watch_fanout_delivered_total",
+    "Events delivered to a watcher callback by its dispatch thread",
+    ["watcher"],
+    registry=REGISTRY,
+)
+WATCH_FANOUT_DISPATCH_LAG = Gauge(
+    "watch_fanout_dispatch_lag_seconds",
+    "Enqueue-to-delivery latency of the most recent event per watcher",
+    ["watcher"],
+    registry=REGISTRY,
+)
+
 
 def registry_value(sample_name: str,
                    labels: dict[str, str] | None = None) -> float:

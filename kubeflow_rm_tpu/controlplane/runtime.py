@@ -95,7 +95,7 @@ class Manager:
         # run_forever blocks on this between drains; enqueue sets it so
         # watch events are served at HTTP latency, not poll latency
         self._wake = threading.Event()
-        api.add_watcher(self._on_event)
+        api.add_watcher(self._on_event, name="manager")
 
     def _queue_clock(self) -> float:
         # queues measure time on the apiserver's injected clock, so
@@ -129,6 +129,13 @@ class Manager:
                 self.enqueue(c, Request(namespace_of(obj), name_of(obj)))
 
     def _on_event(self, event: str, obj: dict, old: dict | None) -> None:
+        if event == "TOO_OLD":
+            # this watcher's fanout queue overflowed and the dropped
+            # window can't be replayed — resync every controller from
+            # a fresh list (the informer's 410 relist runs first: it
+            # registered its watcher before ours)
+            self.enqueue_all()
+            return
         for c in self.controllers:
             if obj["kind"] == c.kind:
                 self.enqueue(c, Request(namespace_of(obj), name_of(obj)))
@@ -144,7 +151,14 @@ class Manager:
         immediately — deterministic drains keep the historical
         immediate-retry semantics). Returns reconcile count."""
         count = 0
+        # async fanout barrier: events from the previous batch's writes
+        # must land in the queues before we decide "idle" (the kube
+        # adapter has no drain — its watch threads are real-time and
+        # run_forever is the serving loop there)
+        drain = getattr(self.api, "drain_watchers", None)
         for _ in range(max_iterations):
+            if drain is not None:
+                drain()
             batch = [(c, req) for c in self.controllers
                      for req in self._queues[c.name].pop_ready(
                          ignore_backoff=True)]

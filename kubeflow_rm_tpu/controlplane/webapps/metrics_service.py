@@ -94,6 +94,19 @@ def _controlplane_section(api=None) -> dict:
             "conflict_fastpath": cp_metrics.registry_value(
                 "cache_conflict_fastpath_total"),
         },
+        # async watch-fanout health (apiserver per-watcher dispatch
+        # queues): sustained depth or overflows mean a consumer can't
+        # keep up with the event rate and is being forced to relist
+        "fanout": {
+            "queue_depth": cp_metrics.registry_value(
+                "watch_fanout_queue_depth"),
+            "overflows": cp_metrics.registry_value(
+                "watch_fanout_overflows_total"),
+            "delivered": cp_metrics.registry_value(
+                "watch_fanout_delivered_total"),
+            "dispatch_lag_s": cp_metrics.registry_value(
+                "watch_fanout_dispatch_lag_seconds"),
+        },
     }
 
 
@@ -226,6 +239,13 @@ class PrometheusMetricsService:
                         "cache_suppressed_writes_total"),
                     "conflict_fastpath": g.get(
                         "cache_conflict_fastpath_total"),
+                },
+                "fanout": {
+                    "queue_depth": g.get("watch_fanout_queue_depth"),
+                    "overflows": g.get("watch_fanout_overflows_total"),
+                    "delivered": g.get("watch_fanout_delivered_total"),
+                    "dispatch_lag_s": g.get(
+                        "watch_fanout_dispatch_lag_seconds"),
                 },
             },
         }

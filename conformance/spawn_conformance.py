@@ -76,6 +76,7 @@ def wallclock_main(args) -> int:
         "mode": "wallclock",
         "cache": "off" if args.no_cache else "on",
         "lock": "global" if args.global_lock else "sharded",
+        "writes": "serial" if args.serial_writes else "batched",
         "notebooks": args.notebooks,
         "concurrency": max(1, args.concurrency),
         "slice": runs[0]["slice"],
@@ -380,6 +381,11 @@ def main() -> int:
                     help="run the apiserver on the pre-r08 single "
                          "global RLock with synchronous watch delivery "
                          "— the sharded/async A/B baseline arm")
+    ap.add_argument("--serial-writes", action="store_true",
+                    help="restore the pre-r09 write path: sequential "
+                         "child writes in reconcile_children and "
+                         "per-object pod creates instead of bulk — the "
+                         "batched-write A/B baseline arm")
     ap.add_argument("--hang-dump", type=float, default=0.0, metavar="S",
                     help="arm faulthandler to dump every thread's "
                          "stack after S seconds (CI contention-stress "
@@ -388,6 +394,10 @@ def main() -> int:
                     help="also write the result JSON to this file "
                          "(PROVISION_r{N}.json artifact)")
     args = ap.parse_args()
+    # module-level switch: covers every Manager in this process (the
+    # platform manager AND the wallclock kubelet both import runtime)
+    from kubeflow_rm_tpu.controlplane import runtime
+    runtime.set_serial_writes(args.serial_writes)
     if args.hang_dump > 0:
         # a deadlock in the sharded locking scheme must fail CI with
         # stacks, not eat the job's timeout silently

@@ -207,12 +207,22 @@ class Batcher:
 
 def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
              window_ms: float = 5.0, max_batch: int = 8,
-             speculative: bool = False, tokenizer=None):
+             speculative: bool = False, tokenizer=None,
+             fused_int4: bool = False):
     """werkzeug WSGI app + its Batcher. ``mesh`` switches the backend
     to the sharded ``make_generate_step`` program; ``speculative``
     routes solo greedy requests through the single-program
     prompt-lookup decoder (repetitive text decodes in fewer model
-    passes; see ``generate_speculative_fused``)."""
+    passes; see ``generate_speculative_fused``).
+
+    int4 weights default to the per-token ``generate`` loop, NOT the
+    fused program: the fused scan re-unpacks every nibble-packed
+    weight on every one of its max_new_tokens steps inside one XLA
+    program, and at batch 8 on 7B that costs 612.77 ms/token vs the
+    loop's 137.07 (``BENCH_SWEEP_r05.json`` ``decode_7b`` — int4 is a
+    capacity lever, not a speed one). ``fused_int4=True`` opts back
+    into the fused program anyway (e.g. behind a network tunnel where
+    ~10 ms/token of per-step dispatch dominates)."""
     import jax
     import numpy as np
     from werkzeug.exceptions import BadRequest, HTTPException
@@ -220,8 +230,16 @@ def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
     from werkzeug.wrappers import Request, Response
 
     from kubeflow_rm_tpu.models import (
-        generate_fused, generate_speculative_fused, make_generate_step,
+        generate, generate_fused, generate_speculative_fused,
+        make_generate_step,
     )
+
+    int4_params = any(
+        isinstance(leaf, dict) and "q4" in leaf
+        for leaf in jax.tree_util.tree_leaves(
+            params,
+            is_leaf=lambda x: isinstance(x, dict) and "q4" in x))
+    loop_decode = int4_params and not fused_int4 and mesh is None
 
     steps = {}  # (total_len, temperature, top_k) -> sharded step
     LOOKUP_N = 3      # kept in ONE place: guard below + the call
@@ -242,6 +260,11 @@ def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
                 return generate_speculative_fused(
                     params, cfg, ids, max_new_tokens=max_new_tokens,
                     lookup_n=LOOKUP_N)
+            if loop_decode:
+                return generate(
+                    params, cfg, ids, max_new_tokens=max_new_tokens,
+                    key=key, temperature=temperature, top_k=top_k,
+                    max_len=S, pad_counts=pad_counts)
             return generate_fused(
                 params, cfg, ids, max_new_tokens=max_new_tokens,
                 key=key, temperature=temperature, top_k=top_k,
@@ -358,6 +381,13 @@ def main(argv=None) -> int:
                          "(repetitive text decodes in fewer model "
                          "passes; one compile per distinct prompt "
                          "length)")
+    ap.add_argument("--fused-int4", action="store_true",
+                    help="force the fused decode program on int4 "
+                         "weights (default: int4 serves via the "
+                         "per-token loop — the fused scan's nibble "
+                         "re-unpack costs 612.77 ms/tok vs the loop's "
+                         "137.07 at 7B b8, BENCH_SWEEP_r05.json "
+                         "decode_7b)")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--fsdp", type=int, default=0,
                     help="0 = all local devices (with --tp 1 ⇒ "
@@ -405,7 +435,8 @@ def main(argv=None) -> int:
 
     app = make_app(cfg, params, max_new_tokens=args.max_new_tokens,
                    mesh=mesh, max_batch=args.max_batch,
-                   speculative=args.speculative, tokenizer=tokenizer)
+                   speculative=args.speculative, tokenizer=tokenizer,
+                   fused_int4=args.fused_int4)
 
     if args.selftest:
         from werkzeug.test import Client

@@ -87,6 +87,43 @@ TOO_OLD = "TOO_OLD"
 _NULL_CTX = contextlib.nullcontext()
 _EMPTY: dict = {}
 
+# admission chains for bulk creates run on this shared bounded pool in
+# sharded mode (plugins only read, and sharded reads are lock-free);
+# lazily built so import stays thread-free
+_admission_pool = None
+_admission_pool_guard = threading.Lock()
+
+
+def _bulk_admission_pool():
+    global _admission_pool
+    with _admission_pool_guard:
+        if _admission_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _admission_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="bulk-admit")
+        return _admission_pool
+
+
+def status_from_error(exc: Exception) -> dict:
+    """Kube Status-shaped failure dict for one member of a bulk write
+    (the REST facade serializes these verbatim into the List reply)."""
+    code, reason = 500, type(exc).__name__
+    if isinstance(exc, NotFound):
+        code = 404
+    elif isinstance(exc, AlreadyExists):
+        code = 409
+    elif isinstance(exc, Conflict):
+        code = 409
+    elif isinstance(exc, (Invalid, AdmissionDenied)):
+        code = 422
+    return {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": reason, "message": str(exc), "code": code}
+
+
+def is_status(obj: dict) -> bool:
+    """True for a per-item bulk failure marker (vs a created object)."""
+    return isinstance(obj, dict) and obj.get("kind") == "Status"
+
 
 class _WatcherChannel:
     """Bounded per-watcher FIFO drained by a dedicated dispatch thread.
@@ -133,6 +170,30 @@ class _WatcherChannel:
                 self._q.append((TOO_OLD, {}, None, time.monotonic()))
             else:
                 self._q.append(item)
+            self._m_depth.set(len(self._q))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"watch-fanout-{self.name}")
+                self._thread.start()
+            self._cond.notify()
+
+    def publish_many(self, items: list[tuple]) -> None:
+        """Enqueue a whole batch under ONE lock acquisition and one
+        notify — the bulk-create path's coalesced emit (per-event
+        ``publish`` paid a lock round-trip per object per watcher).
+        Batch order is preserved; overflow collapses the window to a
+        single ``TOO_OLD`` exactly like ``publish``."""
+        if not items:
+            return
+        with self._cond:
+            if len(self._q) + len(items) > self.maxlen:
+                self._q.clear()
+                self.overflows += 1
+                self._m_overflow.inc()
+                self._q.append((TOO_OLD, {}, None, time.monotonic()))
+            else:
+                self._q.extend(items)
             self._m_depth.set(len(self._q))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
@@ -323,6 +384,15 @@ class APIServer:
             self._rv += 1
             return str(self._rv)
 
+    def _next_rvs(self, n: int) -> list[str]:
+        """Reserve a contiguous block of ``n`` resourceVersions in one
+        counter acquisition (bulk create). Failed batch members leave
+        gaps — rv is an ordering token, not a dense sequence."""
+        with self._rv_lock:
+            start = self._rv + 1
+            self._rv += n
+            return [str(v) for v in range(start, start + n)]
+
     def set_writer(self, identity: str | None) -> None:
         """Tag subsequent writes from THIS thread with ``identity`` in
         the write log (thread-local: the REST facade serves each
@@ -413,6 +483,115 @@ class APIServer:
             self._log_write("CREATE", obj)
             self._emit("ADDED", obj)
             return _fastcopy(obj)
+
+    def create_many(self, objs: list[dict]) -> list[dict]:
+        """Create a same-kind batch with ONE kind-lock acquisition, one
+        contiguous resourceVersion range, and one coalesced watch emit
+        per channel. Per-object failures (validation, admission, quota,
+        duplicate name) come back as Status-shaped dicts at that
+        object's index — one bad pod rejects only itself, the rest of
+        the slice lands. The admission chain runs per object IN
+        PARALLEL in sharded mode (plugins only read, and sharded reads
+        are lock-free; the global arm keeps it on this thread, whose
+        reentrant verb lock the plugins' reads reenter). Quota and
+        duplicate checks run sequentially in input order so batch-mates
+        count against each other exactly as serial creates would.
+        Watchers observe exactly one ADDED per created object, in rv
+        order."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        if not objs:
+            return []
+        objs = [_fastcopy(o) for o in objs]
+        kind = objs[0]["kind"]
+        for o in objs:
+            if o["kind"] != kind:
+                raise Invalid(
+                    "create_many: all objects must share one kind "
+                    f"(got {o['kind']} in a {kind} batch)")
+        metrics.BULK_CREATE_BATCHES_TOTAL.labels(kind=kind).inc()
+        m_obj = metrics.BULK_CREATE_OBJECTS_TOTAL
+        results: list = [None] * len(objs)
+        admitted: list = [None] * len(objs)
+
+        def _admit(i: int) -> None:
+            o = objs[i]
+            name, ns = name_of(o), namespace_of(o)
+            if kind in CLUSTER_SCOPED_KINDS:
+                o["metadata"].pop("namespace", None)
+            elif ns is None:
+                raise Invalid(
+                    f"{kind}/{name}: namespaced kind requires namespace")
+            elif ("Namespace", None, ns) not in self._view("Namespace"):
+                raise NotFound(f"namespace {ns!r} not found")
+            if kind in self._validators:
+                try:
+                    self._validators[kind](o)
+                except Exception as e:
+                    raise Invalid(f"{kind} {ns}/{name}: {e}") from e
+            admitted[i] = self._run_admission("CREATE", o, None)
+
+        with self._kind_lock(kind):
+            if self._global or len(objs) == 1:
+                for i in range(len(objs)):
+                    try:
+                        _admit(i)
+                    except APIError as e:
+                        results[i] = status_from_error(e)
+            else:
+                futs = [_bulk_admission_pool().submit(_admit, i)
+                        for i in range(len(objs))]
+                for i, fut in enumerate(futs):
+                    try:
+                        fut.result()
+                    except APIError as e:
+                        results[i] = status_from_error(e)
+            pending = [i for i in range(len(objs)) if results[i] is None]
+            rvs = self._next_rvs(len(pending))
+            created: list[dict] = []
+            for j, i in enumerate(pending):
+                o = admitted[i]
+                name = name_of(o)
+                ns = None if kind in CLUSTER_SCOPED_KINDS \
+                    else namespace_of(o)
+                key = self._key(kind, name, ns)
+                try:
+                    if key in self._by_kind.get(kind, _EMPTY):
+                        raise AlreadyExists(
+                            f"{kind} {ns}/{name} already exists")
+                    if self.quota_enforcement and kind == "Pod":
+                        self._enforce_quota(o)
+                except APIError as e:
+                    results[i] = status_from_error(e)
+                    m_obj.labels(kind=kind, result="rejected").inc()
+                    continue
+                meta = o["metadata"]
+                meta["uid"] = new_uid()
+                meta["resourceVersion"] = rvs[j]
+                meta["creationTimestamp"] = self.clock().isoformat()
+                self._by_kind.setdefault(kind, {})[key] = o
+                # publish per insert (cheap shallow copy) so the quota
+                # scan for the NEXT batch-mate sees this one; the watch
+                # emit below stays a single coalesced batch
+                self._publish(kind)
+                self._log_write("CREATE", o)
+                results[i] = _fastcopy(o)
+                created.append(o)
+                m_obj.labels(kind=kind, result="created").inc()
+            for i in range(len(objs)):
+                if results[i] is not None and is_status(results[i]) \
+                        and admitted[i] is None:
+                    m_obj.labels(kind=kind, result="rejected").inc()
+            if created:
+                t = time.monotonic()
+                batch = [("ADDED", _fastcopy(o), None, t) for o in created]
+                if self._global:
+                    for w in list(self._watchers):
+                        for etype, obj_c, old_c, _t in batch:
+                            w(etype, obj_c, old_c)
+                else:
+                    for ch in self._channels:
+                        ch.publish_many(batch)
+        return results
 
     def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
         with self._read_lock():
@@ -640,12 +819,19 @@ class APIServer:
         return self.create(ev)
 
     def events_for(self, involved: dict) -> list[dict]:
+        # scan() + copy-on-match: list() deep-copied EVERY Event in the
+        # namespace per call, and the notebook controller re-emits pod
+        # events each reconcile — under the spawn storm that went
+        # O(notebooks × events)
         ns = namespace_of(involved)
-        return [
-            e for e in self.list("Event", ns)
-            if deep_get(e, "involvedObject", "name") == name_of(involved)
-            and deep_get(e, "involvedObject", "kind") == involved["kind"]
+        name, kind = name_of(involved), involved["kind"]
+        out = [
+            _fastcopy(e) for e in self.scan("Event", ns)
+            if deep_get(e, "involvedObject", "name") == name
+            and deep_get(e, "involvedObject", "kind") == kind
         ]
+        out.sort(key=lambda e: (namespace_of(e) or "", name_of(e)))
+        return out
 
     # ---- SubjectAccessReview (kube-apiserver authorization) ----------
     READ_VERBS = frozenset({"get", "list", "watch"})

@@ -160,14 +160,14 @@ class CachedAPI:
 
     def events_for(self, involved: dict) -> list[dict]:
         if self._serves("Event"):
-            ns = namespace_of(involved)
+            # involved-object index: O(matches) per lookup where the
+            # namespace filter was O(events) — the notebook controller
+            # calls this per pod per reconcile (the re-emit storm)
             return [
                 fast_deepcopy(e)
-                for e in self.store.list_refs("Event", ns)
-                if (e.get("involvedObject") or {}).get("name")
-                == name_of(involved)
-                and (e.get("involvedObject") or {}).get("kind")
-                == involved["kind"]
+                for e in self.store.events_for_ref(
+                    involved["kind"], name_of(involved),
+                    namespace_of(involved))
             ]
         return self.api.events_for(involved)
 
@@ -192,6 +192,31 @@ class CachedAPI:
     def create(self, obj: dict) -> dict:
         out = self.api.create(obj)
         self._fold("ADDED", out)
+        return out
+
+    def create_many(self, objs: list[dict]) -> list[dict]:
+        """Bulk create through the backend's batch verb (one lock/HTTP
+        round trip), folding each created object into the store;
+        per-item Status failures pass through untouched. Backends
+        without the verb fall back to per-object creates."""
+        from kubeflow_rm_tpu.controlplane.apiserver import (
+            APIError,
+            is_status,
+            status_from_error,
+        )
+        creator = getattr(self.api, "create_many", None)
+        if creator is None:
+            out = []
+            for obj in objs:
+                try:
+                    out.append(self.create(obj))
+                except APIError as e:
+                    out.append(status_from_error(e))
+            return out
+        out = creator(objs)
+        for item in out:
+            if not is_status(item):
+                self._fold("ADDED", item)
         return out
 
     def update(self, obj: dict) -> dict:

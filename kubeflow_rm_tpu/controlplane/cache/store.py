@@ -70,6 +70,10 @@ class ObjectStore:
         self._by_label: dict[str, dict[tuple[str, str], set[tuple]]] = {}
         # kind -> {owner_uid: set[key]} (controller + non-controller refs)
         self._by_owner: dict[str, dict[str, set[tuple]]] = {}
+        # Events only: (involved kind, ns, involved name) -> set[key] —
+        # the notebook controller asks "events for this pod/STS" every
+        # reconcile, which scanned the whole Event list per call
+        self._by_involved: dict[tuple, set[tuple]] = {}
         # kind -> {key: {rv: obj}} bounded base history (conflict rebase)
         self._history: dict[str, dict[tuple, "collections.OrderedDict"]] = {}
         # kind -> {key: rv} deletion tombstones guarding replace races
@@ -101,6 +105,17 @@ class ObjectStore:
             uid = ref.get("uid")
             if uid:
                 own.setdefault(uid, set()).add(key)
+        if kind == "Event":
+            ikey = self._involved_key(obj)
+            if ikey is not None:
+                self._by_involved.setdefault(ikey, set()).add(key)
+
+    @staticmethod
+    def _involved_key(event: dict) -> tuple | None:
+        inv = event.get("involvedObject") or {}
+        if not inv.get("kind") or not inv.get("name"):
+            return None
+        return (inv["kind"], namespace_of(event), inv["name"])
 
     def _index_remove(self, kind: str, key: tuple, obj: dict) -> None:
         ns_idx = self._by_ns.get(kind, {})
@@ -123,6 +138,13 @@ class ObjectStore:
                 bucket.discard(key)
                 if not bucket:
                     own.pop(ref.get("uid"), None)
+        if kind == "Event":
+            ikey = self._involved_key(obj)
+            bucket = self._by_involved.get(ikey)
+            if bucket:
+                bucket.discard(key)
+                if not bucket:
+                    self._by_involved.pop(ikey, None)
 
     def _remember(self, kind: str, key: tuple, obj: dict) -> None:
         hist = self._history.setdefault(kind, {}).setdefault(
@@ -297,6 +319,19 @@ class ObjectStore:
                 objs = list(objs)
         objs.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
         return objs
+
+    def events_for_ref(self, involved_kind: str, involved_name: str,
+                       namespace: str | None) -> list[dict]:
+        """Events whose involvedObject matches, via the involved-object
+        index — O(matches), not O(events in namespace). Returns store
+        references; callers must not mutate."""
+        with self._lock:
+            store = self._by_kind.get("Event", {})
+            keys = self._by_involved.get(
+                (involved_kind, namespace, involved_name), ())
+            out = [store[k] for k in keys if k in store]
+        out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
+        return out
 
     def owned_by(self, owner_uid: str,
                  kind: str | None = None) -> list[dict]:

@@ -38,7 +38,9 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     set_controller_reference,
 )
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer, NotFound
-from kubeflow_rm_tpu.controlplane.runtime import Controller, Request
+from kubeflow_rm_tpu.controlplane.runtime import (
+    Controller, Request, reconcile_children,
+)
 
 OAUTH_INJECT_ANNOTATION = "notebooks.kubeflow.org/inject-oauth"
 LOGOUT_URL_ANNOTATION = "notebooks.kubeflow.org/oauth-logout-url"
@@ -73,14 +75,19 @@ class AuthCompanionController(Controller):
         except NotFound:
             return None
 
-        self._reconcile_ca_bundle(api, nb)
-        self._reconcile_network_policies(api, nb)
+        # the four groups touch disjoint objects (ordering matters only
+        # WITHIN a group) — fan them out as callables
+        groups = [
+            lambda: self._reconcile_ca_bundle(api, nb),
+            lambda: self._reconcile_network_policies(api, nb),
+        ]
         if self.set_pipeline_rbac:
-            self._reconcile_pipeline_rbac(api, nb)
+            groups.append(lambda: self._reconcile_pipeline_rbac(api, nb))
         if oauth_enabled(nb):
-            self._reconcile_oauth(api, nb)
+            groups.append(lambda: self._reconcile_oauth(api, nb))
         else:
-            self._reconcile_plain_route(api, nb)
+            groups.append(lambda: self._reconcile_plain_route(api, nb))
+        reconcile_children(api, nb, groups)
         return None
 
     # ---- OAuth machinery (notebook_oauth.go:49-266) ------------------

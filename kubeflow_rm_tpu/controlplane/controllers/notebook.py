@@ -40,9 +40,11 @@ from kubeflow_rm_tpu.controlplane.runtime import (
     copy_statefulset_fields,
     map_by_label,
     map_to_owner,
-    reconcile_child,
+    phase_observer,
+    reconcile_children,
 )
 from kubeflow_rm_tpu.controlplane import metrics
+from kubeflow_rm_tpu.utils.profiling import PhaseRecorder
 
 DEFAULT_CONTAINER_PORT = 8888
 SERVICE_PORT = 80
@@ -62,6 +64,8 @@ class NotebookController(Controller):
         # config like every other knob
         self.use_istio = use_istio
         self.istio_gateway = istio_gateway
+        self.phases = PhaseRecorder()
+        self._observe = phase_observer("notebook", self.phases)
 
     def watches(self):
         return (
@@ -76,28 +80,37 @@ class NotebookController(Controller):
         except NotFound:
             return None  # children follow via GC
 
-        topo = nb_api.tpu_spec(notebook)
-        sts = self._generate_statefulset(notebook, topo)
+        with self._observe("render"):
+            topo = nb_api.tpu_spec(notebook)
+            sts = self._generate_statefulset(notebook, topo)
+            children = [(sts, copy_statefulset_fields)]
+            children += [(svc, copy_service_fields)
+                         for svc in self._generate_services(notebook, topo)]
+            if self.use_istio:
+                children.append((self._generate_virtualservice(notebook),
+                                 _copy_virtualservice_fields))
+
         creating = api.try_get("StatefulSet", req.name, req.namespace) is None
         try:
-            reconcile_child(api, notebook, sts, copy_statefulset_fields)
+            with self._observe("child_writes"):
+                reconcile_children(api, notebook, children)
         except Exception:
             if creating:
-                metrics.NOTEBOOK_CREATE_FAILED_TOTAL.inc()
+                # the STS write itself may have landed before a sibling
+                # failed — only count a failed *create* if it didn't
+                if api.try_get("StatefulSet", req.name,
+                               req.namespace) is None:
+                    metrics.NOTEBOOK_CREATE_FAILED_TOTAL.inc()
+                else:
+                    metrics.NOTEBOOK_CREATE_TOTAL.inc()
             raise
         if creating:
             metrics.NOTEBOOK_CREATE_TOTAL.inc()
 
-        for svc in self._generate_services(notebook, topo):
-            reconcile_child(api, notebook, svc, copy_service_fields)
-
-        if self.use_istio:
-            reconcile_child(api, notebook,
-                            self._generate_virtualservice(notebook),
-                            _copy_virtualservice_fields)
-
-        self._mirror_status(api, notebook, topo)
-        self._reemit_pod_events(api, notebook)
+        with self._observe("status"):
+            self._mirror_status(api, notebook, topo)
+        with self._observe("events"):
+            self._reemit_pod_events(api, notebook)
         return None
 
     # -- rendering -----------------------------------------------------

@@ -20,7 +20,7 @@ from kubeflow_rm_tpu.controlplane.runtime import (
     copy_deployment_fields,
     copy_service_fields,
     map_to_owner,
-    reconcile_child,
+    reconcile_children,
     rwo_mounting_node,
 )
 
@@ -85,13 +85,12 @@ class PVCViewerController(Controller):
                 },
             },
         }
-        reconcile_child(api, viewer, deploy, copy_deployment_fields)
-
         svc = make_object("v1", "Service", f"{name}-pvcviewer", ns, spec={
             "selector": {"pvcviewer": name},
             "ports": [{"port": 80, "targetPort": 8080, "protocol": "TCP"}],
         })
-        reconcile_child(api, viewer, svc, copy_service_fields)
+        reconcile_children(api, viewer, [(deploy, copy_deployment_fields),
+                                         (svc, copy_service_fields)])
 
         live = api.try_get("Deployment", f"{name}-pvcviewer", ns)
         ready = deep_get(live, "status", "readyReplicas", default=0) if live \

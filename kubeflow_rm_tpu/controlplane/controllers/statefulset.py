@@ -31,9 +31,12 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
 )
 from kubeflow_rm_tpu.controlplane.api.tpu import GOOGLE_TPU_RESOURCE
 from kubeflow_rm_tpu.controlplane.apiserver import (
-    AdmissionDenied, APIServer, NotFound,
+    AdmissionDenied, APIServer, NotFound, is_status,
 )
-from kubeflow_rm_tpu.controlplane.runtime import Controller, Request, map_to_owner
+from kubeflow_rm_tpu.controlplane import runtime
+from kubeflow_rm_tpu.controlplane.runtime import (
+    Controller, Request, map_to_owner, phase_observer,
+)
 
 POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"
 
@@ -83,6 +86,7 @@ class StatefulSetController(Controller):
         # surface as FailedScheduling, not be papered over.
         self.auto_ready = auto_ready
         self.virtual_node_fallback = virtual_node_fallback
+        self._observe = phase_observer(self.kind.lower())
 
     def watches(self):
         return (("Pod", map_to_owner("StatefulSet")),)
@@ -92,24 +96,25 @@ class StatefulSetController(Controller):
             sts = api.get(self.kind, req.name, req.namespace)
         except NotFound:
             return None  # pods are GC'd via ownerReferences
-        replicas = deep_get(sts, "spec", "replicas", default=1)
-        ns = req.namespace
+        with self._observe("render"):
+            replicas = deep_get(sts, "spec", "replicas", default=1)
+            ns = req.namespace
 
-        scan = getattr(api, "scan", api.list)  # read-only fast path
-        existing = {
-            name_of(p): p for p in scan("Pod", ns)
-            if any(r.get("uid") == sts["metadata"]["uid"]
-                   for r in p["metadata"].get("ownerReferences", []))
-        }
+            scan = getattr(api, "scan", api.list)  # read-only fast path
+            existing = {
+                name_of(p): p for p in scan("Pod", ns)
+                if any(r.get("uid") == sts["metadata"]["uid"]
+                       for r in p["metadata"].get("ownerReferences", []))
+            }
 
-        # scale down: remove pods at ordinals >= replicas
-        for pname, pod in existing.items():
-            ordinal = _ordinal(pname, req.name)
-            if ordinal is None or ordinal >= replicas:
-                api.delete("Pod", pname, ns)
+            # scale down: remove pods at ordinals >= replicas
+            for pname, pod in existing.items():
+                ordinal = _ordinal(pname, req.name)
+                if ordinal is None or ordinal >= replicas:
+                    api.delete("Pod", pname, ns)
 
-        missing = [i for i in range(replicas)
-                   if f"{req.name}-{i}" not in existing]
+            missing = [i for i in range(replicas)
+                       if f"{req.name}-{i}" not in existing]
 
         # slice admission is all-or-nothing: pre-check EVERY missing pod
         # against namespace quota before creating any. Creating ordinals
@@ -133,26 +138,48 @@ class StatefulSetController(Controller):
             requeue = 30.0
 
         # scale up: create missing ordinals (Parallel policy: all at once)
+        with self._observe("child_writes"):
+            if missing:
+                self._create_missing(api, sts, missing)
+            self._schedule_and_run(api, sts)
+        with self._observe("status"):
+            self._mirror_status(api, sts)
+            from kubeflow_rm_tpu.controlplane import metrics
+            metrics.TPU_CHIPS_REQUESTED.set(sum(
+                _pod_tpu_request(p)
+                for p in getattr(api, "scan", api.list)("Pod")
+                if deep_get(p, "spec", "nodeName")))
+        return requeue
+
+    def _create_missing(self, api: APIServer, sts: dict,
+                        missing: list[int]) -> None:
+        pods = []
         for i in missing:
-            pname = f"{req.name}-{i}"
             pod = self._render_pod(sts, i)
             set_controller_reference(sts, pod)
+            pods.append(pod)
+        create_many = getattr(api, "create_many", None)
+        if (create_many is not None and len(pods) > 1
+                and not runtime.serial_writes()):
+            # whole slice in one verb: one lock acquisition, one rv
+            # range, one coalesced watch emit; admission runs per-pod
+            # inside the batch, failures come back as Status items
+            for pod, res in zip(pods, create_many(pods)):
+                if is_status(res):
+                    api.record_event(
+                        sts, "Warning", "FailedCreate",
+                        f"create Pod {name_of(pod)} failed: "
+                        f"{res.get('message')}")
+            return
+        for pod in pods:
             try:
                 api.create(pod)
             except AdmissionDenied as e:
                 # backstop for admission races the pre-check can't see
-                api.record_event(sts, "Warning", "FailedCreate",
-                                 f"create Pod {pname} failed: {e}")
+                api.record_event(
+                    sts, "Warning", "FailedCreate",
+                    f"create Pod {name_of(pod)} failed: {e}")
                 break  # quota: further ordinals would fail identically
-
-        self._schedule_and_run(api, sts)
-        self._mirror_status(api, sts)
-        from kubeflow_rm_tpu.controlplane import metrics
-        metrics.TPU_CHIPS_REQUESTED.set(sum(
-            _pod_tpu_request(p)
-            for p in getattr(api, "scan", api.list)("Pod")
-            if deep_get(p, "spec", "nodeName")))
-        return requeue
 
     def _missing_pods_fit_quota(self, api: APIServer, sts: dict,
                                 missing: list[int]) -> bool:

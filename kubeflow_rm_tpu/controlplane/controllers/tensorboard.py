@@ -25,7 +25,7 @@ from kubeflow_rm_tpu.controlplane.runtime import (
     copy_deployment_fields,
     copy_service_fields,
     map_to_owner,
-    reconcile_child,
+    reconcile_children,
     rwo_mounting_node,
 )
 
@@ -68,12 +68,12 @@ class TensorboardController(Controller):
         except NotFound:
             return None
         deploy = self._generate_deployment(api, tb)
-        reconcile_child(api, tb, deploy, copy_deployment_fields)
         svc = make_object("v1", "Service", req.name, req.namespace, spec={
             "selector": {"app": req.name},
             "ports": [{"port": 80, "targetPort": 6006, "protocol": "TCP"}],
         })
-        reconcile_child(api, tb, svc, copy_service_fields)
+        reconcile_children(api, tb, [(deploy, copy_deployment_fields),
+                                     (svc, copy_service_fields)])
 
         live = api.try_get("Deployment", req.name, req.namespace)
         ready = deep_get(live, "status", "readyReplicas", default=0) if live \

@@ -44,6 +44,7 @@ from kubeflow_rm_tpu.controlplane.apiserver import (
     Conflict,
     Invalid,
     NotFound,
+    is_status,
 )
 from kubeflow_rm_tpu.controlplane.deploy.kubeclient import RESOURCES
 
@@ -338,6 +339,28 @@ class RestServer:
         elif method == "GET":
             self._send(handler, 200, self._convert_out(
                 route, api.get(kind, route.name, route.namespace)))
+        elif method == "POST" and route.name is None and \
+                params.get("bulk", ["false"])[0] == "true":
+            # bulk create: {"items": [...]} -> 200 List whose items are
+            # created objects or per-item Status failures, index-aligned
+            # with the request (one bad object rejects only itself)
+            body = self._read_json(handler)
+            items = body.get("items")
+            if not isinstance(items, list):
+                raise Invalid("bulk create body must be "
+                              '{"items": [...]}')
+            objs = []
+            for obj in items:
+                obj.setdefault("kind", kind)
+                meta = obj.setdefault("metadata", {})
+                if route.namespace and not meta.get("namespace"):
+                    meta["namespace"] = route.namespace
+                objs.append(self._convert_in(route, obj))
+            out = [item if is_status(item)
+                   else self._convert_out(route, item)
+                   for item in api.create_many(objs)]
+            self._send(handler, 200, {
+                "apiVersion": "v1", "kind": "List", "items": out})
         elif method == "POST":
             obj = self._read_json(handler)
             obj.setdefault("kind", kind)
@@ -498,6 +521,10 @@ class RestServer:
         handler.send_response(code)
         handler.send_header("Content-Type", content_type)
         handler.send_header("Content-Length", str(len(data)))
+        # explicit keep-alive: pins close_connection False so the
+        # client's pooled connection survives the response even when a
+        # proxy or an HTTP/1.0 client header would otherwise close it
+        handler.send_header("Connection", "keep-alive")
         handler.end_headers()
         handler.wfile.write(data)
 

@@ -170,6 +170,28 @@ WATCH_FANOUT_DISPATCH_LAG = Gauge(
     registry=REGISTRY,
 )
 
+# ---- batched write path (runtime fan-out + bulk create) --------------
+RECONCILE_PHASE_SECONDS = Histogram(
+    "reconcile_phase_duration_seconds",
+    "Per-reconcile phase timing (render / child_writes / status / "
+    "events) — attributes the provisioning write chain per controller",
+    ["controller", "phase"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+    registry=REGISTRY,
+)
+BULK_CREATE_BATCHES_TOTAL = Counter(
+    "bulk_create_batches_total",
+    "create_many batches accepted by the apiserver, per kind",
+    ["kind"],
+    registry=REGISTRY,
+)
+BULK_CREATE_OBJECTS_TOTAL = Counter(
+    "bulk_create_objects_total",
+    "Objects submitted through create_many by kind and per-item result",
+    ["kind", "result"],
+    registry=REGISTRY,
+)
+
 
 def registry_value(sample_name: str,
                    labels: dict[str, str] | None = None) -> float:

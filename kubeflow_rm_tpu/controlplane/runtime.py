@@ -388,6 +388,130 @@ def reconcile_child(api: APIServer, owner: dict, desired: dict,
     return found
 
 
+# ---- parallel child fan-out ------------------------------------------
+# A Notebook's StatefulSet, Services, and VirtualService have no mutual
+# ordering — issuing them serially turns one reconcile into a string of
+# HTTP round-trips (PROVISION_r08: cr_to_statefulset 204ms p50 under the
+# 20-way storm). reconcile_children fans independent child writes onto a
+# bounded shared pool; --serial-writes flips the module switch below to
+# restore the serial arm for A/B runs.
+
+_serial_writes = False
+_child_pool = None
+_child_pool_lock = None
+_CHILD_POOL_WORKERS = 16
+_CHILD_CONFLICT_RETRIES = 4
+
+
+def set_serial_writes(enabled: bool) -> None:
+    """Force the pre-batched write path: reconcile_children runs its
+    children sequentially and controllers fall back to per-object
+    creates (the ``--serial-writes`` conformance arm)."""
+    global _serial_writes
+    _serial_writes = bool(enabled)
+
+
+def serial_writes() -> bool:
+    return _serial_writes
+
+
+def _shared_child_pool():
+    global _child_pool, _child_pool_lock
+    if _child_pool_lock is None:
+        import threading
+        _child_pool_lock = threading.Lock()
+    with _child_pool_lock:
+        if _child_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _child_pool = ThreadPoolExecutor(
+                max_workers=_CHILD_POOL_WORKERS,
+                thread_name_prefix="child-fanout")
+        return _child_pool
+
+
+def _run_child(api: APIServer, owner: dict, child) -> dict:
+    """One child write with a per-child Conflict retry budget.
+    reconcile_child re-reads via try_get on every attempt, so a retry
+    sees the rv that beat us; a Conflict that survives the budget
+    surfaces to the Manager's rate limiter like any serial write."""
+    for attempt in range(_CHILD_CONFLICT_RETRIES + 1):
+        try:
+            if callable(child):
+                return child()
+            desired, copy_fields = child
+            return reconcile_child(api, owner, desired, copy_fields)
+        except Conflict:
+            if attempt >= _CHILD_CONFLICT_RETRIES:
+                raise
+
+
+def reconcile_children(api: APIServer, owner: dict,
+                       children: list) -> list:
+    """Issue independent child writes concurrently on a bounded shared
+    pool. Each child is either a ``(desired, copy_fields)`` pair routed
+    through :func:`reconcile_child` or a zero-arg callable (for
+    controllers with bespoke ensure logic). Conflicts retry per child
+    before surfacing; every child runs to completion even when a
+    sibling fails, then the first error (in input order) is raised —
+    one bad child still fails the reconcile, but it can't strand its
+    siblings half-written. Returns results in input order."""
+    if not children:
+        return []
+    if _serial_writes or len(children) == 1:
+        return [_run_child(api, owner, child) for child in children]
+    pool = _shared_child_pool()
+    futures = [pool.submit(_run_child, api, owner, child)
+               for child in children[1:]]
+    results: list = [None] * len(children)
+    errors: list = [None] * len(children)
+    # run the first child on the calling thread: the reconcile worker
+    # contributes a hand instead of idling, and the fan-out makes
+    # progress even with the shared pool saturated by sibling reconciles
+    try:
+        results[0] = _run_child(api, owner, children[0])
+    except Exception as e:
+        errors[0] = e
+    for i, fut in enumerate(futures, start=1):
+        try:
+            results[i] = fut.result()
+        except Exception as e:
+            errors[i] = e
+    for err in errors:
+        if err is not None:
+            raise err
+    return results
+
+
+def phase_observer(controller: str, recorder=None):
+    """Per-reconcile phase timing: returns ``observe(phase)`` context
+    managers feeding both the ``reconcile_phase_duration_seconds``
+    histogram (label children bound once — the observer sits on the
+    reconcile hot path) and an optional ``PhaseRecorder``."""
+    import contextlib
+    import time as _time
+
+    from kubeflow_rm_tpu.controlplane import metrics
+    bound: dict = {}
+
+    @contextlib.contextmanager
+    def observe(phase: str):
+        hist = bound.get(phase)
+        if hist is None:
+            hist = bound.setdefault(
+                phase, metrics.RECONCILE_PHASE_SECONDS.labels(
+                    controller=controller, phase=phase))
+        t0 = _time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = _time.perf_counter() - t0
+            hist.observe(dt)
+            if recorder is not None:
+                recorder.record(phase, dt)
+
+    return observe
+
+
 def copy_statefulset_fields(desired: dict, found: dict) -> bool:
     """Replicas, labels, annotations, pod template (util.go:107-134)."""
     changed = False

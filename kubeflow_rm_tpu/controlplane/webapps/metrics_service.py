@@ -107,6 +107,20 @@ def _controlplane_section(api=None) -> dict:
             "dispatch_lag_s": cp_metrics.registry_value(
                 "watch_fanout_dispatch_lag_seconds"),
         },
+        # batched write path: where reconcile milliseconds go — render
+        # vs child writes vs status vs event re-emission, summed across
+        # controllers (per-controller split lives in /metrics)
+        "reconcile_phases": {
+            p: {
+                "count": cp_metrics.registry_value(
+                    "reconcile_phase_duration_seconds_count",
+                    {"phase": p}),
+                "seconds": cp_metrics.registry_value(
+                    "reconcile_phase_duration_seconds_sum",
+                    {"phase": p}),
+            }
+            for p in ("render", "child_writes", "status", "events")
+        },
     }
 
 
@@ -246,6 +260,14 @@ class PrometheusMetricsService:
                     "delivered": g.get("watch_fanout_delivered_total"),
                     "dispatch_lag_s": g.get(
                         "watch_fanout_dispatch_lag_seconds"),
+                },
+                # phase labels are summed by the flat scrape, so only
+                # the all-phase totals survive here
+                "reconcile_phases": {
+                    "count": g.get(
+                        "reconcile_phase_duration_seconds_count"),
+                    "seconds": g.get(
+                        "reconcile_phase_duration_seconds_sum"),
                 },
             },
         }

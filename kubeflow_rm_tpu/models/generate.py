@@ -207,12 +207,12 @@ def _pick(last, key, *, temperature, top_k):
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def _decode_step(params, cfg, cache, tokens):
+def _decode_step(params, cfg, cache, tokens, pad_counts=None):
     """Module-level jitted ``decode_chunk``: one cache entry per
     (config, shapes), shared across ``generate`` calls — a per-call
     ``jax.jit(lambda ...)`` would be a fresh cache key every time and
     re-trace + re-compile on every generation."""
-    return decode_chunk(params, cfg, cache, tokens)
+    return decode_chunk(params, cfg, cache, tokens, pad_counts)
 
 
 def _fused_decode_loop(params, cfg, prompt, key, *, max_new_tokens,
@@ -502,13 +502,20 @@ def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
              max_new_tokens: int, key: jax.Array | None = None,
              temperature: float = 0.0, top_k: int | None = None,
              eos_id: int | None = None,
-             max_len: int | None = None) -> jax.Array:
+             max_len: int | None = None,
+             pad_counts: jax.Array | None = None) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, Tp).
 
     ``temperature`` 0 (default) is greedy argmax; otherwise softmax
     sampling, optionally truncated to the ``top_k`` highest logits.
     Sequences that emit ``eos_id`` keep it and then repeat it (static
     shapes — the result is (B, Tp + max_new_tokens), pad-right).
+
+    ``pad_counts`` (B,) marks leading left-pad slots per row (the same
+    ragged-batch contract as ``generate_fused``): pads are masked out
+    of attention and positions shift so padded rows match unpadded
+    per-row calls — needed when the serving batcher routes padded
+    batches down this loop path (int4 weights, see serve_llama).
     """
     B, Tp = prompt.shape
     S = max_len or (Tp + max_new_tokens)
@@ -523,7 +530,7 @@ def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
     # as constants (a multi-GB HLO for real models, observed to wedge
     # remote-compile paths)
     cache = init_cache(cfg, B, S)
-    logits, cache = _decode_step(params, cfg, cache, prompt)
+    logits, cache = _decode_step(params, cfg, cache, prompt, pad_counts)
     last = logits[:, -1, :]
 
     out = [prompt]
@@ -539,6 +546,7 @@ def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
             done = done | (nxt == eos_id)
         out.append(nxt[:, None])
         if i + 1 < max_new_tokens:
-            logits, cache = _decode_step(params, cfg, cache, nxt[:, None])
+            logits, cache = _decode_step(params, cfg, cache, nxt[:, None],
+                                         pad_counts)
             last = logits[:, -1, :]
     return jnp.concatenate(out, axis=1)

@@ -60,12 +60,17 @@ def wallclock_main(args) -> int:
     phases = PhaseRecorder()
     runs = []
     throttled = {"calls": 0, "seconds": 0.0}
+    readiness = {"status_gets": 0, "readiness_gets": 0}
     for r in range(max(1, args.runs)):
         res = _wallclock_once(args, phases)
         tr = res.pop("_throttle", None)
         if tr:
             throttled["calls"] += tr["calls"]
             throttled["seconds"] += tr["seconds"]
+        rd = res.pop("_readiness", None)
+        if rd:
+            readiness["status_gets"] += rd["status_gets"]
+            readiness["readiness_gets"] += rd["readiness_gets"]
         runs.append(res)
         print(f"run {r + 1}/{args.runs}: "
               f"p50={res['provision_p50_ms']}ms "
@@ -77,6 +82,12 @@ def wallclock_main(args) -> int:
         "cache": "off" if args.no_cache else "on",
         "lock": "global" if args.global_lock else "sharded",
         "writes": "serial" if args.serial_writes else "batched",
+        "schedule": "legacy" if args.legacy_schedule else "cache",
+        "readiness": {
+            "mode": "poll" if args.poll_readiness else "push",
+            "status_get_requests": readiness["status_gets"],
+            "readiness_requests": readiness["readiness_gets"],
+        },
         "notebooks": args.notebooks,
         "concurrency": max(1, args.concurrency),
         "slice": runs[0]["slice"],
@@ -251,10 +262,17 @@ def _wallclock_once(args, phases) -> dict:
     else:
         raise AssertionError("profile never reconciled over the wire")
 
-    def spawn_one(i: int) -> float:
-        """POST the spawn form, poll the web API until the slice is
-        fully ready (what the SPA's status ladder does); returns the
-        provision wall time. Each worker carries its own Session —
+    def spawn_one(i: int) -> dict:
+        """POST the spawn form, then observe readiness through the web
+        API until the slice is fully ready; returns the provision wall
+        time plus the request counts of the readiness phase.
+
+        Default path: the readiness long-poll (``.../readiness``) —
+        each request parks on the server's ReadinessHub and returns at
+        watch latency, so readiness is NOT quantized to a poll tick
+        and the client issues zero fixed-interval status GETs.
+        ``--poll-readiness`` restores the old 50ms status-GET loop as
+        the A/B baseline arm. Each worker carries its own Session —
         requests Sessions are not thread-safe."""
         s = requests.Session()
         tok = secrets.token_urlsafe(16)
@@ -290,25 +308,61 @@ def _wallclock_once(args, phases) -> dict:
             raise AssertionError(f"wc-{i} POST failed: {resp.text}")
         phases.record("post_return", time.perf_counter() - t0)
         slice_deadline = time.monotonic() + 120
-        while True:
-            # the list endpoint serves summaries without replica
-            # counts; the per-notebook GET returns the raw CR
-            resp = s.get(
-                f"{jwa_url}/api/namespaces/conformance/notebooks/wc-{i}")
-            nb = resp.json().get("notebook", {}) \
-                if resp.status_code == 200 else {}
-            if (nb.get("status") or {}).get(
-                    "readyReplicas") == topo.hosts:
-                return time.perf_counter() - t0
-            if time.monotonic() > slice_deadline:
-                raise AssertionError(
-                    f"wc-{i} never ready: {nb.get('status')}")
-            # fixed 50ms poll: with the parallel manager the server
-            # side absorbs N pollers fine, and a concurrency-scaled
-            # interval would quantize the very latency being measured
-            # (20-way × 20ms = 400ms floor — the old r4 artifact's
-            # first ~fifth of its 2.05s p50 was the poll itself)
-            time.sleep(0.05)
+        status_gets = 0
+        readiness_gets = 0
+        if args.poll_readiness:
+            while True:
+                # the list endpoint serves summaries without replica
+                # counts; the per-notebook GET returns the raw CR
+                resp = s.get(f"{jwa_url}/api/namespaces/conformance/"
+                             f"notebooks/wc-{i}")
+                status_gets += 1
+                nb = resp.json().get("notebook", {}) \
+                    if resp.status_code == 200 else {}
+                if (nb.get("status") or {}).get(
+                        "readyReplicas") == topo.hosts:
+                    break
+                if time.monotonic() > slice_deadline:
+                    raise AssertionError(
+                        f"wc-{i} never ready: {nb.get('status')}")
+                # fixed 50ms poll: with the parallel manager the server
+                # side absorbs N pollers fine, and a concurrency-scaled
+                # interval would quantize the very latency being
+                # measured (20-way × 20ms = 400ms floor — the old r4
+                # artifact's first ~fifth of its 2.05s p50 was the
+                # poll itself)
+                time.sleep(0.05)
+        else:
+            # push path: re-subscribe with the last observed
+            # resourceVersion; the server blocks until the CR moves,
+            # so there is no sleep anywhere in this loop
+            known = ""
+            while True:
+                resp = s.get(
+                    f"{jwa_url}/api/namespaces/conformance/"
+                    f"notebooks/wc-{i}/readiness",
+                    params={"timeoutSeconds": 30,
+                            "knownVersion": known})
+                readiness_gets += 1
+                if resp.status_code == 200:
+                    nb = resp.json().get("notebook", {})
+                    if (nb.get("status") or {}).get(
+                            "readyReplicas") == topo.hosts:
+                        break
+                    known = str((nb.get("metadata") or {}).get(
+                        "resourceVersion") or "")
+                else:
+                    # 404 = long-poll expired before the CR became
+                    # visible to the web app's informer — re-subscribe
+                    # from scratch (still no fixed-interval sleep)
+                    known = ""
+                if time.monotonic() > slice_deadline:
+                    raise AssertionError(
+                        f"wc-{i} never ready: "
+                        f"{resp.status_code} {resp.text[:200]}")
+        return {"latency": time.perf_counter() - t0,
+                "status_gets": status_gets,
+                "readiness_gets": readiness_gets}
 
     t_start = time.perf_counter()
     try:
@@ -316,7 +370,8 @@ def _wallclock_once(args, phases) -> dict:
 
         workers = max(1, args.concurrency)
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            latencies = list(pool.map(spawn_one, range(args.notebooks)))
+            spawns = list(pool.map(spawn_one, range(args.notebooks)))
+        latencies = [sp["latency"] for sp in spawns]
         total = time.perf_counter() - t_start
         _phases_from_write_log(list(capi.write_log), "wc-",
                                topo.hosts, phases)
@@ -340,6 +395,11 @@ def _wallclock_once(args, phases) -> dict:
         "provision_p95_ms": round(
             lat_sorted[max(0, int(len(latencies) * 0.95) - 1)] * 1e3, 1),
         "total_s": round(total, 2),
+        "_readiness": {
+            "status_gets": sum(sp["status_gets"] for sp in spawns),
+            "readiness_gets": sum(sp["readiness_gets"]
+                                  for sp in spawns),
+        },
     }
     if kapi.limiter is not None:
         result["_throttle"] = {
@@ -386,6 +446,17 @@ def main() -> int:
                          "child writes in reconcile_children and "
                          "per-object pod creates instead of bulk — the "
                          "batched-write A/B baseline arm")
+    ap.add_argument("--legacy-schedule", action="store_true",
+                    help="restore the pre-r10 scheduler: per-reconcile "
+                         "full Pod scans under one global bind lock "
+                         "instead of the incremental usage cache with "
+                         "gang assume/bind — the scheduler A/B "
+                         "baseline arm")
+    ap.add_argument("--poll-readiness", action="store_true",
+                    help="restore the pre-r10 readiness client: fixed "
+                         "50ms status-GET polling instead of the "
+                         "readiness long-poll — the push-readiness "
+                         "A/B baseline arm (wallclock mode)")
     ap.add_argument("--hang-dump", type=float, default=0.0, metavar="S",
                     help="arm faulthandler to dump every thread's "
                          "stack after S seconds (CI contention-stress "
@@ -396,8 +467,9 @@ def main() -> int:
     args = ap.parse_args()
     # module-level switch: covers every Manager in this process (the
     # platform manager AND the wallclock kubelet both import runtime)
-    from kubeflow_rm_tpu.controlplane import runtime
+    from kubeflow_rm_tpu.controlplane import runtime, scheduler
     runtime.set_serial_writes(args.serial_writes)
+    scheduler.set_legacy_scan(args.legacy_schedule)
     if args.hang_dump > 0:
         # a deadlock in the sharded locking scheme must fail CI with
         # stacks, not eat the job's timeout silently

@@ -33,9 +33,13 @@ from kubeflow_rm_tpu.controlplane.api.tpu import GOOGLE_TPU_RESOURCE
 from kubeflow_rm_tpu.controlplane.apiserver import (
     AdmissionDenied, APIServer, NotFound, is_status,
 )
-from kubeflow_rm_tpu.controlplane import runtime
+from kubeflow_rm_tpu.controlplane import runtime, scheduler
 from kubeflow_rm_tpu.controlplane.runtime import (
-    Controller, Request, map_to_owner, phase_observer,
+    Controller, Request, map_all_in_namespace, map_to_owner,
+    phase_observer,
+)
+from kubeflow_rm_tpu.controlplane.scheduler import (
+    TERMINAL_PHASES, VIRTUAL_NODE,
 )
 
 POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"
@@ -89,7 +93,11 @@ class StatefulSetController(Controller):
         self._observe = phase_observer(self.kind.lower())
 
     def watches(self):
-        return (("Pod", map_to_owner("StatefulSet")),)
+        # ResourceQuota: a raised quota must requeue every STS in its
+        # namespace immediately — a quota-rejected slice used to wait
+        # out a 30s poll before admission
+        return (("Pod", map_to_owner("StatefulSet")),
+                ("ResourceQuota", map_all_in_namespace("StatefulSet")))
 
     def reconcile(self, api: APIServer, req: Request):
         try:
@@ -122,7 +130,6 @@ class StatefulSetController(Controller):
         # chips while the jax rendezvous waits forever, or (if torn
         # down) free the quota and retry in an endless create/teardown
         # loop. Reject whole, once, with an event.
-        requeue = None
         if missing and not self._missing_pods_fit_quota(api, sts, missing):
             msg = (f"namespace quota cannot admit all {replicas} hosts "
                    "of the slice; rejecting whole (slice admission is "
@@ -133,9 +140,8 @@ class StatefulSetController(Controller):
                 api.record_event(sts, "Warning", "SliceAdmissionFailed",
                                  msg)
             missing = []
-            # nothing watches ResourceQuota: poll so a raised quota
-            # eventually admits the slice (level-triggered retry)
-            requeue = 30.0
+            # no timed poll: the ResourceQuota watch (watches() above)
+            # requeues this STS the moment the quota is raised
 
         # scale up: create missing ordinals (Parallel policy: all at once)
         with self._observe("child_writes"):
@@ -145,11 +151,18 @@ class StatefulSetController(Controller):
         with self._observe("status"):
             self._mirror_status(api, sts)
             from kubeflow_rm_tpu.controlplane import metrics
-            metrics.TPU_CHIPS_REQUESTED.set(sum(
-                _pod_tpu_request(p)
-                for p in getattr(api, "scan", api.list)("Pod")
-                if deep_get(p, "spec", "nodeName")))
-        return requeue
+            if scheduler.legacy_scan():
+                metrics.TPU_CHIPS_REQUESTED.set(sum(
+                    _pod_tpu_request(p)
+                    for p in getattr(api, "scan", api.list)("Pod")
+                    if deep_get(p, "spec", "nodeName")
+                    and deep_get(p, "status", "phase")
+                    not in TERMINAL_PHASES))
+            else:
+                # O(nodes) from the usage cache, not an O(pods) scan
+                metrics.TPU_CHIPS_REQUESTED.set(
+                    scheduler.cache_for(api).total_used())
+        return None
 
     def _create_missing(self, api: APIServer, sts: dict,
                         missing: list[int]) -> None:
@@ -249,31 +262,102 @@ class StatefulSetController(Controller):
         return pod
 
     # -- scheduling + status (the fake kubelet) ------------------------
-    #: scheduling is a read-compute-write over SHARED node capacity:
-    #: two parallel reconciles (Manager workers > 1) that both read
-    #: `used` before either binds a pod would over-commit a node's
-    #: chips — the kube-scheduler equivalent is a single serialized
-    #: assume/bind cycle, so serialize ours the same way
+    #: legacy arm only: scheduling there is a read-compute-write over
+    #: SHARED node capacity, serialized whole under one global lock.
+    #: The default path runs assume/bind against the incremental usage
+    #: cache in ``controlplane/scheduler.py`` — per-node locks, no
+    #: global serialization, no per-reconcile Pod scan.
     _bind_lock = __import__("threading").Lock()
 
     def _schedule_and_run(self, api: APIServer, sts: dict) -> None:
-        with self._bind_lock:
-            self._schedule_and_run_locked(api, sts)
+        if scheduler.legacy_scan():
+            with self._bind_lock:
+                self._schedule_and_run_locked(api, sts)
+            return
+        self._schedule_and_run_cached(api, sts)
 
-    def _schedule_and_run_locked(self, api: APIServer, sts: dict) -> None:
-        ns = namespace_of(sts)
-        scan = getattr(api, "scan", api.list)
-        nodes = scan("Node")
-        # this STS's pods ARE mutated below (nodeName/status) -> copies
-        pods = [p for p in api.list("Pod", ns)
+    def _owned_pods(self, api: APIServer, sts: dict) -> list[dict]:
+        # this STS's pods ARE mutated by the kubelet half -> copies
+        return [p for p in api.list("Pod", namespace_of(sts))
                 if any(r.get("uid") == sts["metadata"]["uid"]
                        for r in p["metadata"].get("ownerReferences", []))]
 
-        # chips already committed per node
+    def _allow_virtual(self, api: APIServer) -> bool:
+        return (self.virtual_node_fallback
+                if self.virtual_node_fallback is not None
+                # unwrap a CachedAPI: the backend decides — hermetic
+                # in-memory yes, real cluster no
+                else isinstance(getattr(api, "api", api), APIServer))
+
+    def _mark_unschedulable(self, api: APIServer, pod: dict) -> None:
+        if deep_get(pod, "status", "phase") != "Pending":
+            pod["status"] = {"phase": "Pending"}
+            api.update_status(pod)
+        if not any(e["reason"] == "FailedScheduling"
+                   for e in api.events_for(pod)):
+            api.record_event(
+                pod, "Warning", "FailedScheduling",
+                "no node matches TPU nodeSelector with free "
+                f"{GOOGLE_TPU_RESOURCE} capacity")
+
+    def _schedule_and_run_cached(self, api: APIServer, sts: dict) -> None:
+        """Assume/bind over the incremental usage cache: the whole
+        slice gang-binds all-or-nothing, each bind charged to the cache
+        before its write and confirmed with the write's rv (or
+        forgotten on failure) — concurrent reconciles can't over-commit
+        a node no matter how far the watch stream lags."""
+        sched = scheduler.cache_for(api)
+        unbound = []
+        for pod in sorted(self._owned_pods(api, sts), key=name_of):
+            if deep_get(pod, "spec", "nodeName"):
+                # pre-pinned (RWO node affinity) or already scheduled:
+                # the kubelet half still owes it a Running status
+                if (self.auto_ready
+                        and deep_get(pod, "status", "phase")
+                        not in ("Running",) + TERMINAL_PHASES):
+                    # terminal pods stay terminal — recovery is the
+                    # slice-health controller's whole-slice decision,
+                    # and a real kubelet never resurrects a Failed or
+                    # Succeeded pod
+                    self.mark_running(api, pod)
+                continue
+            unbound.append(pod)
+        if not unbound:
+            return
+        plan = sched.gang_bind(
+            unbound, allow_virtual=self._allow_virtual(api))
+        if plan is None:
+            for pod in unbound:
+                self._mark_unschedulable(api, pod)
+            return
+        for pod in unbound:
+            key = (namespace_of(pod), name_of(pod))
+            pod["spec"]["nodeName"] = plan[key]
+            try:
+                live = api.update(pod)
+            except Exception:
+                # bind write lost (conflict/deleted): release the
+                # assumed charge; the retried reconcile re-plans
+                sched.forget(key)
+                raise
+            sched.confirm(key, deep_get(
+                live, "metadata", "resourceVersion", default=0))
+            if self.auto_ready:
+                self.mark_running(api, pod, live=live)
+
+    def _schedule_and_run_locked(self, api: APIServer, sts: dict) -> None:
+        scan = getattr(api, "scan", api.list)
+        nodes = scan("Node")
+        pods = self._owned_pods(api, sts)
+
+        # chips already committed per node; terminal pods hold none (a
+        # Failed host must free its chips for the replacement slice,
+        # not leak them until the Pod object is deleted)
         used: dict[str, float] = {}
         for p in scan("Pod"):
             node = deep_get(p, "spec", "nodeName")
-            if node:
+            if node and deep_get(p, "status", "phase") \
+                    not in TERMINAL_PHASES:
                 used[node] = used.get(node, 0.0) + _pod_tpu_request(p)
 
         for pod in sorted(pods, key=name_of):
@@ -282,23 +366,12 @@ class StatefulSetController(Controller):
                 # the kubelet half still owes it a Running status
                 if (self.auto_ready
                         and deep_get(pod, "status", "phase")
-                        not in ("Running", "Failed")):
-                    # Failed pods stay failed — recovery is the
-                    # slice-health controller's whole-slice decision,
-                    # and a real kubelet never resurrects a Failed pod
+                        not in ("Running",) + TERMINAL_PHASES):
                     self.mark_running(api, pod)
                 continue
             node = self._pick_node(api, pod, nodes, used)
             if node is None:
-                if deep_get(pod, "status", "phase") != "Pending":
-                    pod["status"] = {"phase": "Pending"}
-                    api.update_status(pod)
-                if not any(e["reason"] == "FailedScheduling"
-                           for e in api.events_for(pod)):
-                    api.record_event(
-                        pod, "Warning", "FailedScheduling",
-                        "no node matches TPU nodeSelector with free "
-                        f"{GOOGLE_TPU_RESOURCE} capacity")
+                self._mark_unschedulable(api, pod)
                 continue
             used[name_of(node)] = used.get(name_of(node), 0.0) + \
                 _pod_tpu_request(pod)
@@ -307,8 +380,12 @@ class StatefulSetController(Controller):
             if self.auto_ready:
                 self.mark_running(api, pod)
 
-    def mark_running(self, api: APIServer, pod: dict) -> None:
-        pod = api.get("Pod", name_of(pod), namespace_of(pod))
+    def mark_running(self, api: APIServer, pod: dict,
+                     live: dict | None = None) -> None:
+        # a caller holding the pod's freshly-written state (the bind
+        # update's return) passes it as ``live`` to skip the re-read
+        pod = live if live is not None else api.get(
+            "Pod", name_of(pod), namespace_of(pod))
         containers = deep_get(pod, "spec", "containers", default=[]) or []
         pod["status"] = {
             "phase": "Running",
@@ -362,15 +439,9 @@ class StatefulSetController(Controller):
                 if used.get(name_of(node), 0.0) + need > cap:
                     continue
             return node
-        allow_virtual = (self.virtual_node_fallback
-                         if self.virtual_node_fallback is not None
-                         # unwrap a CachedAPI: the backend decides —
-                         # hermetic in-memory yes, real cluster no
-                         else isinstance(getattr(api, "api", api),
-                                         APIServer))
-        if allow_virtual and not selector and not need:
+        if self._allow_virtual(api) and not selector and not need:
             # plain CPU pod: runnable even in a test with no Node inventory
-            return {"metadata": {"name": "virtual-node"}}
+            return {"metadata": {"name": VIRTUAL_NODE}}
         return None
 
     def _mirror_status(self, api: APIServer, sts: dict) -> None:

@@ -252,6 +252,15 @@ class RestServer:
             self._send_raw(handler, 200, b"ok",
                            content_type="text/plain")
             return
+        if parsed.path == "/metrics" and method == "GET":
+            # Prometheus exposition of the control-plane registry —
+            # schedule_latency_seconds / readiness_wake_to_observe /
+            # fanout gauges etc. scraped over the same socket the
+            # conformance harness already talks to
+            from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+            self._send_raw(handler, 200, cp_metrics.scrape(),
+                           content_type="text/plain; version=0.0.4")
+            return
 
         route = _parse_path(parsed.path)
         if route is None:
@@ -547,7 +556,16 @@ class RestServer:
             def log_message(self, *a):
                 pass
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), H)
+        class S(ThreadingHTTPServer):
+            # http.server's default listen backlog is 5; boot opens a
+            # dozen-plus concurrent connections (watch streams per kind
+            # per client, pooled writers, readiness long-polls) and a
+            # SYN dropped off a full backlog retransmits after the
+            # kernel's 1s initial RTO — a whole second of phantom
+            # provision latency for whichever stream loses the race
+            request_queue_size = 128
+
+        self._httpd = S(("127.0.0.1", self.port), H)
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
         return self._httpd.server_address[1]

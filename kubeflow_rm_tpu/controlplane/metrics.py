@@ -192,6 +192,51 @@ BULK_CREATE_OBJECTS_TOTAL = Counter(
     registry=REGISTRY,
 )
 
+# ---- incremental scheduler + push readiness --------------------------
+SCHEDULE_LATENCY_SECONDS = Histogram(
+    "schedule_latency_seconds",
+    "Gang-bind latency per scheduling attempt: node selection + "
+    "capacity check + assume, over the incremental usage cache "
+    "(kube-scheduler's scheduling_attempt_duration_seconds analogue); "
+    "result=bound|unschedulable",
+    ["result"],
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+             5.0),
+    registry=REGISTRY,
+)
+SCHEDULER_ASSUMED_PODS = Gauge(
+    "scheduler_assumed_pods",
+    "Pods assumed (bound in the usage cache, bind write not yet "
+    "confirmed by its watch event) — kube-scheduler's assumed-pod set",
+    registry=REGISTRY,
+)
+SCHEDULER_CACHE_EVENTS_TOTAL = Counter(
+    "scheduler_cache_events_total",
+    "Pod/Node watch events folded into the scheduler's usage cache "
+    "(the O(Δ) accounting replacing the per-reconcile full Pod scan)",
+    ["kind"],
+    registry=REGISTRY,
+)
+SCHEDULER_CACHE_REBUILDS_TOTAL = Counter(
+    "scheduler_cache_rebuilds_total",
+    "Full usage-cache rebuilds from a fresh snapshot (initial prime + "
+    "TOO_OLD relists)",
+    registry=REGISTRY,
+)
+READINESS_WAKE_TO_OBSERVE_SECONDS = Histogram(
+    "readiness_wake_to_observe_seconds",
+    "Watch-event arrival at the web app's readiness hub to a blocked "
+    "readiness long-poll observing the change — the push-path latency "
+    "that replaces the client's fixed-interval status polling",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0),
+    registry=REGISTRY,
+)
+READINESS_WAITERS = Gauge(
+    "readiness_waiters",
+    "Readiness long-polls currently blocked on the hub",
+    registry=REGISTRY,
+)
+
 
 def registry_value(sample_name: str,
                    labels: dict[str, str] | None = None) -> float:

@@ -71,6 +71,22 @@ def map_by_label(label: str) -> Callable[[dict], list[Request]]:
     return fn
 
 
+def map_all_in_namespace(kind: str):
+    """Map an event to EVERY object of ``kind`` in the event object's
+    namespace — for namespace-scoped admission inputs (ResourceQuota)
+    whose change can unblock any primary in that namespace. Needs the
+    manager's api handle to enumerate, so it's marked ``wants_api`` and
+    ``Manager._on_event`` calls it as ``fn(api, obj)``."""
+
+    def fn(api: APIServer, obj: dict) -> list[Request]:
+        ns = namespace_of(obj)
+        return [Request(namespace_of(o), name_of(o))
+                for o in getattr(api, "scan", api.list)(kind, ns)]
+
+    fn.wants_api = True
+    return fn
+
+
 class Manager:
     """Runs controllers against an APIServer until the system is idle."""
 
@@ -141,7 +157,10 @@ class Manager:
                 self.enqueue(c, Request(namespace_of(obj), name_of(obj)))
             for kind, map_fn in c.watches():
                 if obj["kind"] == kind:
-                    for req in map_fn(obj):
+                    reqs = (map_fn(self.api, obj)
+                            if getattr(map_fn, "wants_api", False)
+                            else map_fn(obj))
+                    for req in reqs:
                         if req.name:
                             self.enqueue(c, req)
 

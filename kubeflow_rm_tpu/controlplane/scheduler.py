@@ -1,0 +1,447 @@
+"""Incremental scheduler cache: kube-scheduler assume/bind for TPU slices.
+
+The fake kubelet in ``controllers/statefulset.py`` used to recompute
+per-node chip usage by scanning EVERY Pod in the cluster under one
+global bind lock on every StatefulSet reconcile — O(pods) per reconcile,
+O(pods²) aggregate under the 20-way spawn storm. This module replaces
+that with what kube-scheduler actually does (``scheduler/cache/cache.go``):
+
+- an informer-fed usage map, updated O(Δ) from Pod/Node watch events,
+  with per-pod resourceVersion guards so stale events can't unwind a
+  newer accounting state;
+- **assume/bind**: a bind is charged to the cache synchronously at
+  decision time (before the apiserver write), then *confirmed* with the
+  write's resourceVersion or *forgotten* on failure — so two concurrent
+  reconciles can never double-commit the same chips no matter how far
+  the watch stream lags;
+- **gang-bind**: a whole slice's pods are placed all-or-nothing under
+  per-node locks (sorted acquisition), the scheduling unit a TPU slice
+  actually is — no rump slices holding chips while the jax rendezvous
+  waits forever;
+- **relist rebuild**: a ``TOO_OLD`` overflow sentinel marks the cache
+  stale and the next scheduling attempt rebuilds it from a fresh
+  snapshot, preserving in-flight assumed pods (kube-scheduler keeps its
+  assumed set across relists for the same reason).
+
+Terminal pods (``Succeeded``/``Failed``) hold no capacity — a failed
+host frees its chips the moment its status event lands, where the old
+full scan leaked them forever (the r10 satellite bugfix).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    deep_get,
+    labels_of,
+    matches_selector,
+    name_of,
+    namespace_of,
+    parse_quantity,
+)
+from kubeflow_rm_tpu.controlplane.api.tpu import GOOGLE_TPU_RESOURCE
+
+#: phases whose pods no longer occupy their node's chips (a kubelet
+#: frees the device plugin allocation when the pod reaches a terminal
+#: phase; only the DELETE frees the name)
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+#: the hermetic fallback node for selector-less CPU pods (tests with no
+#: Node inventory); never capacity-tracked
+VIRTUAL_NODE = "virtual-node"
+
+#: entry.rv sentinel while a bind is assumed but its write's rv is not
+#: yet known — compares newer than every real resourceVersion
+_ASSUMED = float("inf")
+
+
+def _pod_chips(pod: dict) -> float:
+    """TPU chips a pod occupies: requests defaulting to limits (the
+    kube quota convention — mirrors ``statefulset._pod_tpu_request``)."""
+    total = 0.0
+    for c in deep_get(pod, "spec", "containers", default=[]) or []:
+        amount = deep_get(c, "resources", "requests", GOOGLE_TPU_RESOURCE)
+        if amount is None:
+            amount = deep_get(c, "resources", "limits", GOOGLE_TPU_RESOURCE)
+        if amount is not None:
+            total += parse_quantity(amount)
+    return total
+
+
+class _Node:
+    """One node's slice of the usage map. ``used`` is guarded by the
+    node's own lock — binds against different nodes never contend."""
+
+    __slots__ = ("name", "labels", "capacity", "used", "lock")
+
+    def __init__(self, name: str, labels: dict, capacity: float):
+        self.name = name
+        self.labels = labels
+        self.capacity = capacity
+        self.used = 0.0
+        self.lock = threading.Lock()
+
+
+class _Entry:
+    __slots__ = ("node", "chips", "rv")
+
+    def __init__(self, node: str, chips: float, rv: float):
+        self.node = node
+        self.chips = chips
+        self.rv = rv
+
+
+class SchedulerCache:
+    """Informer-fed per-node chip accounting with assume/bind.
+
+    Lock order (held-simultaneously pairs only): ``_relist_lock`` →
+    node locks (sorted by name) → ``_plock``. The event path takes
+    ``_plock`` and node locks sequentially, never nested.
+    """
+
+    def __init__(self, backend=None):
+        self._nodes: dict[str, _Node] = {}
+        self._pods: dict[tuple[str | None, str], _Entry] = {}
+        self._plock = threading.Lock()       # the pod→entry map
+        self._nlock = threading.Lock()       # node-map membership
+        self._relist_lock = threading.Lock()  # rebuild vs bind-commit
+        self._stale = True                   # prime on first use
+        self._assumed = 0
+        self._backend = (weakref.ref(backend)
+                         if backend is not None else None)
+
+    # -- the informer feed (one dispatch thread per backend) -----------
+    def observe(self, etype: str, obj: dict, old: dict | None = None) -> None:
+        if etype == "TOO_OLD":
+            # the fanout queue overflowed: the dropped window can't be
+            # replayed, so the next scheduling attempt rebuilds from a
+            # fresh snapshot (kube-scheduler's 410 relist)
+            self._stale = True
+            return
+        kind = obj.get("kind")
+        if kind == "Node":
+            self._apply_node(etype, obj)
+        elif kind == "Pod":
+            self._apply_pod(etype, obj)
+
+    def _apply_node(self, etype: str, obj: dict) -> None:
+        from kubeflow_rm_tpu.controlplane import metrics
+        metrics.SCHEDULER_CACHE_EVENTS_TOTAL.labels(kind="Node").inc()
+        name = name_of(obj)
+        with self._nlock:
+            if etype == "DELETED":
+                self._nodes.pop(name, None)
+                return
+            node = self._nodes.get(name)
+            cap = parse_quantity(deep_get(
+                obj, "status", "allocatable", GOOGLE_TPU_RESOURCE,
+                default=0))
+            if node is None:
+                self._nodes[name] = _Node(name, labels_of(obj), cap)
+            else:
+                # keep the object (its lock + used survive relabels)
+                node.labels = labels_of(obj)
+                node.capacity = cap
+
+    def _apply_pod(self, etype: str, obj: dict) -> None:
+        from kubeflow_rm_tpu.controlplane import metrics
+        metrics.SCHEDULER_CACHE_EVENTS_TOTAL.labels(kind="Pod").inc()
+        key = (namespace_of(obj), name_of(obj))
+        try:
+            rv = float(obj["metadata"].get("resourceVersion") or 0)
+        except (TypeError, ValueError):
+            rv = 0.0
+        gone = (etype == "DELETED"
+                or deep_get(obj, "status", "phase") in TERMINAL_PHASES)
+        node_name = None if gone else deep_get(obj, "spec", "nodeName")
+        chips = _pod_chips(obj)
+        with self._plock:
+            cur = self._pods.get(key)
+            if cur is not None and rv < cur.rv:
+                # stale event (assumed entries compare newest): a bind
+                # already charged this pod at a later version — applying
+                # the older view would free chips that are still held
+                return
+            dec = (cur.node, cur.chips) if cur is not None else None
+            if node_name:
+                self._pods[key] = _Entry(node_name, chips, rv)
+                inc = (node_name, chips)
+            else:
+                self._pods.pop(key, None)
+                inc = None
+        self._adjust(dec, inc)
+
+    def _adjust(self, dec: tuple[str, float] | None,
+                inc: tuple[str, float] | None) -> None:
+        if dec == inc:
+            return
+        for node_name, delta in ((dec, -1), (inc, +1)):
+            if node_name is None:
+                continue
+            name, chips = node_name
+            if not chips:
+                continue
+            with self._nlock:
+                node = self._nodes.get(name)
+            if node is None:
+                continue  # virtual node / node gone: untracked capacity
+            with node.lock:
+                node.used = max(0.0, node.used + delta * chips)
+
+    # -- snapshot rebuild (prime + TOO_OLD recovery) -------------------
+    def rebuild(self, api) -> None:
+        """Replace the accounting with a fresh snapshot, keeping
+        in-flight assumed binds (their writes are racing this relist)."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        scan = getattr(api, "scan", api.list)
+        with self._relist_lock:
+            self._stale = False
+            nodes = list(scan("Node"))
+            pods = list(scan("Pod"))
+            with self._nlock:
+                seen = set()
+                for n in nodes:
+                    name = name_of(n)
+                    seen.add(name)
+                    cap = parse_quantity(deep_get(
+                        n, "status", "allocatable", GOOGLE_TPU_RESOURCE,
+                        default=0))
+                    node = self._nodes.get(name)
+                    if node is None:
+                        self._nodes[name] = _Node(name, labels_of(n), cap)
+                    else:
+                        node.labels = labels_of(n)
+                        node.capacity = cap
+                for name in list(self._nodes):
+                    if name not in seen:
+                        del self._nodes[name]
+                live_nodes = dict(self._nodes)
+            with self._plock:
+                fresh: dict = {}
+                for p in pods:
+                    if deep_get(p, "status", "phase") in TERMINAL_PHASES:
+                        continue
+                    node_name = deep_get(p, "spec", "nodeName")
+                    if not node_name:
+                        continue
+                    key = (namespace_of(p), name_of(p))
+                    try:
+                        rv = float(p["metadata"].get(
+                            "resourceVersion") or 0)
+                    except (TypeError, ValueError):
+                        rv = 0.0
+                    fresh[key] = _Entry(node_name, _pod_chips(p), rv)
+                for key, e in self._pods.items():
+                    if e.rv is _ASSUMED and key not in fresh:
+                        fresh[key] = e
+                self._pods = fresh
+                per_node: dict[str, float] = {}
+                for e in fresh.values():
+                    per_node[e.node] = per_node.get(e.node, 0.0) + e.chips
+            for node in live_nodes.values():
+                with node.lock:
+                    node.used = per_node.get(node.name, 0.0)
+        metrics.SCHEDULER_CACHE_REBUILDS_TOTAL.inc()
+
+    def _ensure_fresh(self) -> None:
+        if not self._stale:
+            return
+        backend = self._backend() if self._backend is not None else None
+        if backend is not None:
+            self.rebuild(backend)
+
+    # -- assume / confirm / forget (the bind protocol) -----------------
+    def gang_bind(self, pods: list[dict], *,
+                  allow_virtual: bool) -> dict[tuple, str] | None:
+        """Place a whole gang all-or-nothing. Returns ``{(ns, name):
+        node_name}`` with every placement *assumed* in the cache, or
+        None (nothing charged) when the gang doesn't fit. The caller
+        must ``confirm`` each bind after its apiserver write lands, or
+        ``forget`` it on failure."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        self._ensure_fresh()
+        t0 = time.perf_counter()
+        plan = self._try_gang(pods, allow_virtual)
+        metrics.SCHEDULE_LATENCY_SECONDS.labels(
+            result="bound" if plan is not None
+            else "unschedulable").observe(time.perf_counter() - t0)
+        return plan
+
+    def _try_gang(self, pods: list[dict],
+                  allow_virtual: bool) -> dict[tuple, str] | None:
+        # pick first (selection without locks), then verify-and-commit
+        # under the chosen nodes' locks; capacity taken by a concurrent
+        # gang between the two phases fails verification and retries
+        for _ in range(4):
+            with self._nlock:
+                nodes = list(self._nodes.values())
+            plan: dict[tuple, str] = {}
+            tentative: dict[str, float] = {}
+            for pod in sorted(pods, key=name_of):
+                key = (namespace_of(pod), name_of(pod))
+                selector = deep_get(pod, "spec", "nodeSelector",
+                                    default={}) or {}
+                need = _pod_chips(pod)
+                chosen = None
+                for node in nodes:
+                    if selector and not matches_selector(
+                            node.labels, {"matchLabels": selector}):
+                        continue
+                    if need:
+                        with node.lock:
+                            used = node.used
+                        if (used + tentative.get(node.name, 0.0) + need
+                                > node.capacity):
+                            continue
+                    chosen = node.name
+                    break
+                if chosen is None:
+                    if allow_virtual and not selector and not need:
+                        plan[key] = VIRTUAL_NODE
+                        continue
+                    return None  # gang is all-or-nothing
+                plan[key] = chosen
+                if need:
+                    tentative[chosen] = tentative.get(chosen, 0.0) + need
+            if self._commit(pods, plan, tentative):
+                return plan
+        return None
+
+    def _commit(self, pods: list[dict], plan: dict[tuple, str],
+                tentative: dict[str, float]) -> bool:
+        """Re-verify capacity and charge the gang under its nodes'
+        locks (sorted acquisition — deadlock-free against sibling
+        gangs), then record the assumed entries."""
+        with self._nlock:
+            locked = [self._nodes[n] for n in sorted(tentative)
+                      if n in self._nodes]
+        if len(locked) != len(tentative):
+            return False  # a chosen node vanished mid-flight
+        with self._relist_lock:
+            for node in locked:
+                node.lock.acquire()
+            try:
+                for node in locked:
+                    if node.used + tentative[node.name] > node.capacity:
+                        return False
+                for node in locked:
+                    node.used += tentative[node.name]
+            finally:
+                for node in locked:
+                    node.lock.release()
+            from kubeflow_rm_tpu.controlplane import metrics
+            stale: list[tuple[str, float]] = []
+            with self._plock:
+                for pod in pods:
+                    key = (namespace_of(pod), name_of(pod))
+                    cur = self._pods.get(key)
+                    if cur is not None:
+                        # re-bind over an existing entry (a stale cached
+                        # list raced a prior bind): release the old
+                        # charge so the gang's doesn't double-count
+                        if cur.rv is _ASSUMED:
+                            self._assumed -= 1
+                        stale.append((cur.node, cur.chips))
+                    self._pods[key] = _Entry(
+                        plan[key], _pod_chips(pod), _ASSUMED)
+                    self._assumed += 1
+                metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
+            for dec in stale:
+                self._adjust(dec, None)
+        return True
+
+    def confirm(self, key: tuple, rv) -> None:
+        """The bind write landed: pin the entry at its resourceVersion
+        so the echo event (and anything older) folds in idempotently."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        try:
+            rv = float(rv)
+        except (TypeError, ValueError):
+            rv = 0.0
+        with self._plock:
+            e = self._pods.get(key)
+            if e is not None and e.rv is _ASSUMED:
+                e.rv = rv
+                self._assumed -= 1
+                metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
+
+    def forget(self, key: tuple) -> None:
+        """The bind write failed: release the assumed charge."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        with self._plock:
+            e = self._pods.get(key)
+            if e is None or e.rv is not _ASSUMED:
+                return
+            del self._pods[key]
+            self._assumed -= 1
+            metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
+        self._adjust((e.node, e.chips), None)
+
+    # -- read-side helpers ---------------------------------------------
+    def total_used(self) -> float:
+        """Chips currently charged across the fleet — O(nodes), serves
+        the ``tpu_chips_requested`` gauge without a Pod scan."""
+        with self._nlock:
+            nodes = list(self._nodes.values())
+        total = 0.0
+        for node in nodes:
+            with node.lock:
+                total += node.used
+        return total
+
+    def node_used(self, name: str) -> float:
+        with self._nlock:
+            node = self._nodes.get(name)
+        if node is None:
+            return 0.0
+        with node.lock:
+            return node.used
+
+    def stats(self) -> dict:
+        with self._plock:
+            pods, assumed = len(self._pods), self._assumed
+        with self._nlock:
+            nodes = len(self._nodes)
+        return {"nodes": nodes, "pods": pods, "assumed": assumed,
+                "stale": self._stale}
+
+
+# ---- per-backend cache registry + the legacy A/B switch --------------
+
+_caches: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_caches_lock = threading.Lock()
+
+_legacy_scan = False
+
+
+def set_legacy_scan(enabled: bool) -> None:
+    """Restore the pre-r10 scheduling path: full Pod scan under the
+    global bind lock per reconcile (the ``--legacy-schedule``
+    conformance arm)."""
+    global _legacy_scan
+    _legacy_scan = bool(enabled)
+
+
+def legacy_scan() -> bool:
+    return _legacy_scan
+
+
+def cache_for(api) -> SchedulerCache:
+    """The one SchedulerCache per apiserver backend, informer-fed from
+    registration time and primed from a snapshot on first use. Accepts
+    a CachedAPI and unwraps it — accounting must feed from the
+    authoritative event stream, not a read cache."""
+    backend = getattr(api, "api", api)
+    with _caches_lock:
+        cache = _caches.get(backend)
+        if cache is None:
+            cache = SchedulerCache(backend)
+            # subscribe BEFORE the first rebuild: an event raced between
+            # snapshot and subscription would be lost forever, while one
+            # arriving twice is absorbed by the rv guards
+            backend.add_watcher(cache.observe, name="scheduler")
+            _caches[backend] = cache
+    return cache

@@ -22,6 +22,7 @@ TPU differences by design:
 from __future__ import annotations
 
 import copy
+import threading
 
 import yaml
 from werkzeug.exceptions import BadRequest, NotFound
@@ -34,6 +35,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer
 from kubeflow_rm_tpu.controlplane.webapps import status as status_mod
 from kubeflow_rm_tpu.controlplane.webapps.core import WebApp, json_body
+from kubeflow_rm_tpu.controlplane.webapps.readiness import ReadinessHub
 
 DEFAULT_CONFIG = __file__.rsplit("/", 1)[0] + "/spawner_ui_config.yaml"
 
@@ -290,6 +292,18 @@ def create_app(api: APIServer, *, config_path: str | None = None,
     app = WebApp("jupyter", api, prefix=prefix, disable_auth=disable_auth, **app_kwargs)
     defaults = load_spawner_config(config_path)
 
+    # readiness hub is built lazily: the in-memory backend spawns a
+    # dispatch thread per watcher, and most app instances (tests,
+    # short-lived tools) never take a readiness long-poll
+    _hub_lock = threading.Lock()
+    _hub_box: list[ReadinessHub] = []
+
+    def _hub() -> ReadinessHub:
+        with _hub_lock:
+            if not _hub_box:
+                _hub_box.append(ReadinessHub(api))
+            return _hub_box[0]
+
     @app.route("/api/config")
     def get_config(req):
         return {"config": defaults}
@@ -339,6 +353,49 @@ def create_app(api: APIServer, *, config_path: str | None = None,
         nb["processed_status"] = status_mod.process_status(
             nb, api.events_for(nb)).to_dict()
         return {"notebook": nb}
+
+    @app.route("/api/namespaces/<namespace>/notebooks/<name>/readiness")
+    def get_notebook_readiness(req, namespace, name):
+        """Long-poll readiness: block until the notebook's
+        resourceVersion moves past ``knownVersion`` (or
+        ``timeoutSeconds`` elapses), woken by the watch stream through
+        the ReadinessHub — the push path that replaces the SPA's and
+        conformance client's fixed-interval status polling. Clients
+        loop: pass the last observed resourceVersion back in and each
+        request returns at watch latency, not poll-tick latency."""
+        app.ensure_authorized(req, "get", "notebooks", namespace)
+        raw = req.args.get("timeoutSeconds", "30")
+        try:
+            timeout = float(raw)
+        except ValueError:
+            raise BadRequest(f"timeoutSeconds must be a number, "
+                             f"got {raw!r}")
+        timeout = max(0.0, min(timeout, 120.0))
+        known = req.args.get("knownVersion", "")
+
+        def fetch():
+            return api.try_get(nb_api.KIND, name, namespace)
+
+        def moved(nb):
+            if nb is None:
+                # a deletion is a change worth reporting — but with no
+                # baseline ("" = first subscribe) keep waiting for the
+                # notebook to appear
+                return known != ""
+            rv = deep_get(nb, "metadata", "resourceVersion", default="")
+            return known == "" or str(rv) != known
+
+        nb, changed = _hub().wait(namespace, name, timeout, fetch, moved)
+        if nb is None:
+            raise NotFound(f"notebook {name} in namespace {namespace} "
+                           f"not found")
+        nb["processed_status"] = status_mod.process_status(
+            nb, api.events_for(nb)).to_dict()
+        desired = deep_get(nb, "status", "desiredReplicas",
+                           default=nb_api.total_hosts(nb))
+        ready_n = deep_get(nb, "status", "readyReplicas", default=0)
+        return {"notebook": nb, "changed": changed,
+                "ready": bool(desired) and ready_n >= desired}
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>/events")
     def get_notebook_events(req, namespace, name):

@@ -121,6 +121,43 @@ def _controlplane_section(api=None) -> dict:
             }
             for p in ("render", "child_writes", "status", "events")
         },
+        # incremental scheduler: gang-bind latency split by outcome,
+        # plus cache health (assumed pods should drain to 0 at idle;
+        # rebuilds beyond the initial prime mean fanout overflow)
+        "scheduler": {
+            "bound": {
+                "count": cp_metrics.registry_value(
+                    "schedule_latency_seconds_count",
+                    {"result": "bound"}),
+                "seconds": cp_metrics.registry_value(
+                    "schedule_latency_seconds_sum",
+                    {"result": "bound"}),
+            },
+            "unschedulable": {
+                "count": cp_metrics.registry_value(
+                    "schedule_latency_seconds_count",
+                    {"result": "unschedulable"}),
+                "seconds": cp_metrics.registry_value(
+                    "schedule_latency_seconds_sum",
+                    {"result": "unschedulable"}),
+            },
+            "assumed_pods": cp_metrics.registry_value(
+                "scheduler_assumed_pods"),
+            "cache_events": cp_metrics.registry_value(
+                "scheduler_cache_events_total"),
+            "cache_rebuilds": cp_metrics.registry_value(
+                "scheduler_cache_rebuilds_total"),
+        },
+        # push readiness: long-polls currently parked on the hub and
+        # the event-arrival -> waiter-observation latency that replaced
+        # the clients' fixed-interval status polling
+        "readiness": {
+            "waiters": cp_metrics.registry_value("readiness_waiters"),
+            "wakes": cp_metrics.registry_value(
+                "readiness_wake_to_observe_seconds_count"),
+            "wake_to_observe_s": cp_metrics.registry_value(
+                "readiness_wake_to_observe_seconds_sum"),
+        },
     }
 
 
@@ -268,6 +305,24 @@ class PrometheusMetricsService:
                         "reconcile_phase_duration_seconds_count"),
                     "seconds": g.get(
                         "reconcile_phase_duration_seconds_sum"),
+                },
+                # result labels (bound/unschedulable) are summed by
+                # the flat scrape — only combined attempt totals here
+                "scheduler": {
+                    "attempts": g.get("schedule_latency_seconds_count"),
+                    "seconds": g.get("schedule_latency_seconds_sum"),
+                    "assumed_pods": g.get("scheduler_assumed_pods"),
+                    "cache_events": g.get(
+                        "scheduler_cache_events_total"),
+                    "cache_rebuilds": g.get(
+                        "scheduler_cache_rebuilds_total"),
+                },
+                "readiness": {
+                    "waiters": g.get("readiness_waiters"),
+                    "wakes": g.get(
+                        "readiness_wake_to_observe_seconds_count"),
+                    "wake_to_observe_s": g.get(
+                        "readiness_wake_to_observe_seconds_sum"),
                 },
             },
         }

@@ -207,9 +207,10 @@ def test_workload_identity_plugin_annotates_editor_sa(stack):
 
 
 def test_raised_quota_admits_rejected_slice_on_requeue():
-    """A quota-rejected slice must come up once the quota is raised —
-    the controller polls via timed requeue (nothing watches
-    ResourceQuota)."""
+    """A quota-rejected slice must come up THE MOMENT the quota is
+    raised: the StatefulSet controller watches ResourceQuota
+    (map_all_in_namespace) and the update event requeues it — no timed
+    poll, so the injected clock never advances here."""
     from tests.cp_fixtures import FakeClock
 
     clock = FakeClock()
@@ -228,7 +229,7 @@ def test_raised_quota_admits_rejected_slice_on_requeue():
     quota = api.get("ResourceQuota", profile_api.QUOTA_NAME, "grace")
     quota["spec"]["hard"]["google.com/tpu"] = "8"
     api.update(quota)
-    clock.advance(seconds=31)
+    # deliberately NO clock.advance: the quota event alone must admit
     mgr.run_until_idle()
     pods = api.list("Pod", "grace")
     assert len(pods) == 2, [p["metadata"]["name"] for p in pods]

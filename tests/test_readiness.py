@@ -1,0 +1,189 @@
+"""Push-based readiness hub (webapps/readiness.py): watch-latency
+wakeups, slow-client isolation, and waiter accounting."""
+
+import threading
+import time
+
+from kubeflow_rm_tpu.controlplane import metrics
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get
+from kubeflow_rm_tpu.controlplane.api.notebook import KIND, make_notebook
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.webapps.readiness import (
+    _GUARD_TICK_S, ReadinessHub,
+)
+
+
+def _mk(api: APIServer, name: str) -> dict:
+    api.ensure_namespace("d")
+    return api.create(make_notebook(name, "d", accelerator_type="v5p-8"))
+
+
+def test_waiter_wakes_at_watch_latency_not_poll_tick():
+    """A blocked readiness wait must observe a status write at watch
+    latency — far below both the old 50ms poll tick's worst case and
+    the hub's 1s guard tick (which would mask a lost wakeup)."""
+    api = APIServer()
+    hub = ReadinessHub(api)
+    nb = _mk(api, "nb")
+    baseline = deep_get(nb, "metadata", "resourceVersion")
+
+    got: dict = {}
+
+    def waiter():
+        def fetch():
+            return api.try_get(KIND, "nb", "d")
+
+        def moved(obj):
+            return (obj is not None and str(deep_get(
+                obj, "metadata", "resourceVersion")) != str(baseline))
+
+        got["obj"], got["changed"] = hub.wait("d", "nb", 10.0, fetch, moved)
+        got["t"] = time.monotonic()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)  # let the waiter park on the condition
+    nb["status"] = {"readyReplicas": 2}
+    api.update_status(nb)
+    t_write = time.monotonic()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert got["changed"] is True
+    dt = got["t"] - t_write
+    assert dt < 0.5 * _GUARD_TICK_S, \
+        f"wakeup took {dt:.3f}s — guard tick, not the watch, woke it"
+
+
+def test_slow_waiter_does_not_stall_writers_and_drains():
+    """A parked (slow/disconnected) long-poll must not back-pressure
+    the write path: 20 rapid writes complete while a waiter is blocked
+    on a notebook that never becomes ready, and when that waiter's
+    timeout lapses the READINESS_WAITERS gauge returns to zero."""
+    api = APIServer()
+    hub = ReadinessHub(api)
+    _mk(api, "stuck")
+    waiters_before = metrics.registry_value("readiness_waiters")
+
+    def fetch():
+        return api.try_get(KIND, "stuck", "d")
+
+    results: dict = {}
+
+    def parked():
+        # predicate never satisfied: emulates a client whose notebook
+        # never comes up (or who went away; the wait just runs out)
+        results["r"] = hub.wait("d", "stuck", 1.5, fetch, lambda o: False)
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.05)
+    assert metrics.registry_value("readiness_waiters") == waiters_before + 1
+
+    t0 = time.monotonic()
+    for i in range(20):
+        _mk(api, f"burst-{i}")
+    write_s = time.monotonic() - t0
+    # writers only enqueue onto the async fanout; the parked waiter's
+    # existence must not serialize them (generous bound for CI noise)
+    assert write_s < 1.0, f"20 writes took {write_s:.3f}s with a waiter parked"
+
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    obj, changed = results["r"]
+    assert changed is False and obj is not None
+    assert metrics.registry_value("readiness_waiters") == waiters_before
+
+
+def test_sibling_events_do_not_wake_unrelated_waiters():
+    """Wakeups are keyed by (namespace, name): a storm of OTHER
+    notebooks' events must not thundering-herd a parked waiter into
+    re-fetching its own object over and over."""
+    api = APIServer()
+    hub = ReadinessHub(api)
+    _mk(api, "stuck")
+    fetches = []
+
+    def fetch():
+        fetches.append(1)
+        return api.try_get(KIND, "stuck", "d")
+
+    def parked():
+        hub.wait("d", "stuck", 0.8, fetch, lambda o: False)
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.05)
+    before = len(fetches)
+    for i in range(30):
+        _mk(api, f"sibling-{i}")
+    api.drain_watchers()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    # only the initial fetch plus guard-tick re-checks — sibling events
+    # (30 of them) must not each trigger a refetch
+    assert len(fetches) - before <= 2, \
+        f"{len(fetches) - before} refetches caused by sibling events"
+
+
+def test_event_during_fetch_is_not_lost():
+    """The no-lost-wakeup property: a write landing between the
+    waiter's fetch and its wait must bump the sequence snapshot and
+    short-circuit the sleep — asserted by injecting the write from
+    inside the fetch callback itself."""
+    api = APIServer()
+    hub = ReadinessHub(api)
+    nb = _mk(api, "nb")
+    baseline = str(deep_get(nb, "metadata", "resourceVersion"))
+    fired = threading.Event()
+
+    def fetch():
+        obj = api.try_get(KIND, "nb", "d")
+        if not fired.is_set():
+            fired.set()
+            # the racing write: lands AFTER this fetch's snapshot view
+            nb["status"] = {"readyReplicas": 1}
+            api.update_status(nb)
+            api.drain_watchers()
+        return obj
+
+    def moved(obj):
+        return (obj is not None and str(deep_get(
+            obj, "metadata", "resourceVersion")) != baseline)
+
+    t0 = time.monotonic()
+    obj, changed = hub.wait("d", "nb", 10.0, fetch, moved)
+    dt = time.monotonic() - t0
+    assert changed is True
+    assert dt < 0.5 * _GUARD_TICK_S, \
+        f"lost wakeup: took {dt:.3f}s (guard tick recovered it)"
+
+
+def test_too_old_overflow_wakes_all_waiters():
+    """A fanout overflow (TOO_OLD) means state is unknown: every
+    parked waiter must wake and re-evaluate its predicate promptly,
+    not ride out the guard tick."""
+    api = APIServer()
+    hub = ReadinessHub(api)
+    _mk(api, "nb")
+    results = []
+
+    def parked():
+        seen = []
+
+        def moved(obj):
+            seen.append(1)
+            return len(seen) > 1  # first check parks, re-check passes
+
+        results.append(hub.wait("d", "nb", 10.0,
+                                lambda: api.try_get(KIND, "nb", "d"),
+                                moved))
+
+    t = threading.Thread(target=parked)
+    t.start()
+    time.sleep(0.05)
+    t0 = time.monotonic()
+    hub._on_event("TOO_OLD", {})
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert time.monotonic() - t0 < 0.5 * _GUARD_TICK_S
+    assert results[0][1] is True

@@ -1,0 +1,333 @@
+"""Incremental scheduler cache: assume/bind accounting, gang
+all-or-nothing semantics, relist recovery, and the terminal-phase
+capacity-leak regression (controlplane/scheduler.py)."""
+
+import threading
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane, scheduler
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.api.tpu import GOOGLE_TPU_RESOURCE
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+    make_tpu_node,
+)
+from kubeflow_rm_tpu.controlplane.scheduler import SchedulerCache
+
+
+def _node(name: str, chips: int) -> dict:
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {}},
+            "status": {"allocatable": {GOOGLE_TPU_RESOURCE: str(chips)},
+                       "capacity": {GOOGLE_TPU_RESOURCE: str(chips)}}}
+
+
+def _pod(name: str, chips: int, node: str | None = None,
+         ns: str = "d", phase: str | None = None) -> dict:
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": ns},
+           "spec": {"containers": [{"name": "c", "resources": {
+               "requests": {GOOGLE_TPU_RESOURCE: str(chips)}}}]}}
+    if node:
+        pod["spec"]["nodeName"] = node
+    if phase:
+        pod["status"] = {"phase": phase}
+    return pod
+
+
+# ---- event accounting ------------------------------------------------
+
+def test_cache_accounts_pod_events_incrementally():
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+    assert cache.node_used("n0") == 0.0
+
+    pod = api.create(_pod("p0", 4, node="n0"))
+    cache.observe("ADDED", pod)
+    assert cache.node_used("n0") == 4.0
+    cache.observe("DELETED", pod)
+    assert cache.node_used("n0") == 0.0
+
+
+def test_terminal_phase_pod_releases_capacity_in_cache():
+    """The r10 satellite bugfix at the cache layer: a pod reaching
+    Succeeded/Failed frees its chips on the status EVENT, not only on
+    DELETE — the old full scan counted any pod with a nodeName."""
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    pod = api.create(_pod("p0", 4, node="n0"))
+    cache.observe("ADDED", pod)
+    assert cache.node_used("n0") == 4.0
+    pod["status"] = {"phase": "Failed"}
+    pod = api.update_status(pod)
+    cache.observe("MODIFIED", pod)
+    assert cache.node_used("n0") == 0.0
+    # rebuild from snapshot agrees (terminal pods skipped there too)
+    cache.rebuild(api)
+    assert cache.node_used("n0") == 0.0
+
+
+def test_stale_event_cannot_unwind_newer_accounting():
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    newer = _pod("p0", 4, node="n0")
+    newer["metadata"]["resourceVersion"] = "7"
+    cache.observe("ADDED", newer)
+    assert cache.node_used("n0") == 4.0
+    older = _pod("p0", 4)  # unbound view from before the bind
+    older["metadata"]["resourceVersion"] = "3"
+    cache.observe("MODIFIED", older)
+    assert cache.node_used("n0") == 4.0  # ignored: rv 3 < 7
+
+
+# ---- relist rebuild --------------------------------------------------
+
+def test_too_old_relist_rebuilds_usage_from_snapshot():
+    """A fanout overflow (TOO_OLD) marks the cache stale; the next
+    scheduling attempt rebuilds from the store and the usage map
+    matches reality again — including events lost in the gap."""
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    api.create(_node("n1", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    # these writes never reach the cache as events (the lost window)
+    api.create(_pod("p0", 4, node="n0"))
+    api.create(_pod("p1", 8, node="n1"))
+    api.create(_pod("gone", 4, node="n0", phase="Failed"))
+    assert cache.node_used("n0") == 0.0
+
+    cache.observe("TOO_OLD", {})
+    assert cache.stats()["stale"] is True
+    # gang_bind's _ensure_fresh triggers the rebuild; n1 is full so the
+    # 4-chip pod must land on n0 next to the existing 4-chip pod
+    plan = cache.gang_bind([_pod("p2", 4)], allow_virtual=False)
+    assert plan == {("d", "p2"): "n0"}
+    assert cache.stats()["stale"] is False
+    assert cache.node_used("n0") == 8.0  # p0 + p2; Failed pod excluded
+    assert cache.node_used("n1") == 8.0
+
+
+def test_relist_preserves_assumed_binds():
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    plan = cache.gang_bind([_pod("p0", 8)], allow_virtual=False)
+    assert plan == {("d", "p0"): "n0"}
+    # the bind write hasn't landed: a relist snapshot doesn't contain
+    # the pod, but the assumed charge must survive it
+    cache.rebuild(api)
+    assert cache.node_used("n0") == 8.0
+    assert cache.gang_bind([_pod("p1", 8)], allow_virtual=False) is None
+
+
+# ---- assume / confirm / forget ---------------------------------------
+
+def test_forget_releases_assumed_charge():
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    cache.gang_bind([_pod("p0", 8)], allow_virtual=False)
+    assert cache.node_used("n0") == 8.0
+    cache.forget(("d", "p0"))
+    assert cache.node_used("n0") == 0.0
+    assert cache.stats()["assumed"] == 0
+
+
+def test_confirm_pins_rv_against_echo_events():
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    cache.gang_bind([_pod("p0", 4)], allow_virtual=False)
+    cache.confirm(("d", "p0"), 9)
+    assert cache.stats()["assumed"] == 0
+    # an event OLDER than the bind write folds in as a no-op
+    stale = _pod("p0", 4)
+    stale["metadata"]["resourceVersion"] = "5"
+    cache.observe("MODIFIED", stale)
+    assert cache.node_used("n0") == 4.0
+    # ... but the bind's own echo (same rv, nodeName set) is accepted
+    echo = _pod("p0", 4, node="n0")
+    echo["metadata"]["resourceVersion"] = "9"
+    cache.observe("MODIFIED", echo)
+    assert cache.node_used("n0") == 4.0
+
+
+# ---- gang semantics --------------------------------------------------
+
+def test_gang_bind_is_all_or_nothing():
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    # 12 chips over one 8-chip node: nothing may be charged
+    gang = [_pod("g0", 8), _pod("g1", 4)]
+    assert cache.gang_bind(gang, allow_virtual=False) is None
+    assert cache.node_used("n0") == 0.0
+    assert cache.stats()["assumed"] == 0
+
+
+def test_concurrent_gang_binds_never_overcommit():
+    """The assume/bind point of the whole design: many reconcile
+    workers racing gang_bind for the same nodes must admit exactly as
+    many gangs as the fleet holds, and never overshoot a node."""
+    api = APIServer()
+    api.ensure_namespace("d")
+    nodes = 4
+    for i in range(nodes):
+        api.create(_node(f"n{i}", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    gangs = 10  # 10 × 2 pods × 8 chips over 4 × 8-chip nodes → 2 fit
+    barrier = threading.Barrier(gangs)
+    plans: list = [None] * gangs
+
+    def bind(i: int):
+        gang = [_pod(f"g{i}-a", 8), _pod(f"g{i}-b", 8)]
+        barrier.wait()
+        plans[i] = cache.gang_bind(gang, allow_virtual=False)
+
+    threads = [threading.Thread(target=bind, args=(i,))
+               for i in range(gangs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    won = [p for p in plans if p is not None]
+    assert len(won) == 2, f"{len(won)} gangs admitted into 2 slots"
+    for i in range(nodes):
+        assert cache.node_used(f"n{i}") <= 8.0
+    assert cache.total_used() == 32.0
+    # each winner's placements are disjoint whole nodes
+    placed = [n for p in won for n in p.values()]
+    assert len(placed) == len(set(placed)) == 4
+
+
+# ---- the controller-level regression (both arms) ---------------------
+
+@pytest.mark.parametrize("legacy", [False, True],
+                         ids=["cache", "legacy-scan"])
+def test_succeeded_slice_frees_capacity_for_next_slice(legacy):
+    """Regression for the terminal-phase leak: a slice whose pods
+    reached a terminal phase must not pin the fleet's chips — the next
+    slice schedules onto the freed hosts. Succeeded is the phase that
+    exercises the leak end-to-end (a Failed slice is torn down and
+    replaced whole by the slice-health controller). Asserted on BOTH
+    the incremental cache and the --legacy-schedule full-scan arm; also
+    guards the fake kubelet against resurrecting a terminal pod."""
+    scheduler.set_legacy_scan(legacy)
+    try:
+        api, mgr = make_control_plane()
+        api.ensure_namespace("d")
+        for h in range(2):
+            api.create(make_tpu_node(f"n{h}", "v5p-16"))
+        api.create(make_notebook("first", "d", accelerator_type="v5p-16"))
+        mgr.enqueue_all()
+        mgr.run_until_idle()
+        pods = [p for p in api.list("Pod", "d")
+                if p["metadata"]["name"].startswith("first-")]
+        assert len(pods) == 2
+        assert all(deep_get(p, "status", "phase") == "Running"
+                   for p in pods)
+
+        # the workload runs to completion: kubelet reports Succeeded
+        for p in pods:
+            p["status"]["phase"] = "Succeeded"
+            api.update_status(p)
+        mgr.run_until_idle()
+        first = [p for p in api.list("Pod", "d")
+                 if p["metadata"]["name"].startswith("first-")]
+        assert all(deep_get(p, "status", "phase") == "Succeeded"
+                   for p in first), "kubelet resurrected a terminal pod"
+
+        api.create(make_notebook("second", "d",
+                                 accelerator_type="v5p-16"))
+        mgr.run_until_idle()
+        second = [p for p in api.list("Pod", "d")
+                  if p["metadata"]["name"].startswith("second-")]
+        assert len(second) == 2
+        assert all(deep_get(p, "status", "phase") == "Running"
+                   for p in second), [
+            (p["metadata"]["name"], deep_get(p, "status", "phase"))
+            for p in second]
+        assert all(deep_get(p, "spec", "nodeName") for p in second)
+    finally:
+        scheduler.set_legacy_scan(False)
+
+
+def test_failed_slice_capacity_flows_to_replacement():
+    """The Failed flavor of the leak: slice-health tears the slice
+    down and the StatefulSet controller re-creates it — the
+    replacement ordinals must be schedulable (the Failed originals'
+    charges released at the status event, not leaked until DELETE)."""
+    api, mgr = make_control_plane()
+    api.ensure_namespace("d")
+    for h in range(2):
+        api.create(make_tpu_node(f"n{h}", "v5p-16"))
+    api.create(make_notebook("nb", "d", accelerator_type="v5p-16"))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    for p in api.list("Pod", "d"):
+        p["status"]["phase"] = "Failed"
+        api.update_status(p)
+    mgr.run_until_idle()
+    pods = [p for p in api.list("Pod", "d")
+            if p["metadata"]["name"].startswith("nb-")]
+    assert len(pods) == 2
+    assert all(deep_get(p, "status", "phase") == "Running"
+               for p in pods), [
+        (p["metadata"]["name"], deep_get(p, "status", "phase"))
+        for p in pods]
+    # and the accounting settled at exactly one slice's chips
+    assert scheduler.cache_for(api).total_used() == 8.0
+
+
+def test_statefulset_status_excludes_terminal_pods_from_gauge():
+    """tpu_chips_requested must drop a Succeeded pod's chips in both
+    accounting paths (the gauge half of the leak)."""
+    from kubeflow_rm_tpu.controlplane import metrics
+
+    api, mgr = make_control_plane()
+    api.ensure_namespace("d")
+    for h in range(2):
+        api.create(make_tpu_node(f"n{h}", "v5p-16"))
+    api.create(make_notebook("nb", "d", accelerator_type="v5p-16"))
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    assert metrics.registry_value("tpu_chips_requested") == 8.0
+
+    for p in api.list("Pod", "d"):
+        p["status"]["phase"] = "Succeeded"
+        api.update_status(p)
+    # requeue the STS so the gauge recomputes off the settled cache
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    assert metrics.registry_value("tpu_chips_requested") == 0.0

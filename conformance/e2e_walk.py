@@ -38,6 +38,10 @@ Scenarios (each emits ok/skip + wall ms into the JSON artifact):
                        the lease window and recreates a deleted
                        StatefulSet; the apiserver write log proves no
                        dead-leader write lands after takeover
+  oversubscription     more slices than the fleet: suspend parks one
+                       (chips re-gang the waiter), a high-priority
+                       resume preempts exactly one victim, the pinned
+                       notebook is never chosen
   delete_cascade       deleting the CR garbage-collects every
                        satellite object
 
@@ -428,6 +432,78 @@ class Walk:
                 "new_leader": standby["identity"],
                 "dead_writes_after_takeover": 0}
 
+    def oversubscription(self):
+        """The NotebookOS loop over the socket stack: more slices than
+        the fleet holds; suspending one parks it (phase Suspended, chips
+        freed), the waiting gang binds into the freed slice, and a
+        high-priority resume preempts its way back all-or-nothing —
+        while the pinned main notebook is never chosen as a victim."""
+        from kubeflow_rm_tpu.controlplane import suspend as suspend_mod
+
+        # pin the walk's notebook: do-not-suspend for its lifetime
+        self.api.patch("Notebook", "walk", {"metadata": {"annotations": {
+            nb_api.PIN_ANNOTATION: "true"}}}, NS)
+        names = ("ov-a", "ov-b", "ov-c")
+        for name in names:
+            self.api.create(make_notebook(
+                name, NS, accelerator_type=ACCEL, image=self.image,
+                priority_class="high" if name == "ov-a" else None,
+                annotations={
+                    nb_api.CULLING_EXCLUDE_ANNOTATION: "true"}))
+        # fleet: 3 slices, walk holds one -> ov-a and ov-b gang, ov-c
+        # must wait whole (no rump)
+        self.nb_ready("ov-a")
+        self.nb_ready("ov-b")
+        time.sleep(0.5)  # give ov-c every chance to (wrongly) bind
+        pending = self.api.get("Notebook", "ov-c", NS)
+        assert (pending.get("status") or {}).get(
+            "readyReplicas", 0) == 0, "ov-c bound past a full fleet"
+
+        # suspend ov-a through the lifecycle verbs (snapshot -> stamp ->
+        # drain); its chips must re-gang the waiting ov-c
+        self.api.patch("Notebook", "ov-a", {"metadata": {"annotations": {
+            nb_api.TRAINING_STEP_ANNOTATION: "41"}}}, NS)
+        suspend_mod.initiate_suspend(
+            self.api, self.api.get("Notebook", "ov-a", NS), reason="api")
+        self.wait(lambda: (self.api.get("Notebook", "ov-a", NS)
+                           .get("status") or {}).get("phase")
+                  == nb_api.SUSPENDED_PHASE, what="ov-a Suspended")
+        t0 = time.perf_counter()
+        self.nb_ready("ov-c")
+        backfill_ms = round(1e3 * (time.perf_counter() - t0), 1)
+
+        # resume ov-a into a full fleet: high priority preempts exactly
+        # one default victim; the pinned walk is never selected
+        suspend_mod.request_resume(
+            self.api, self.api.get("Notebook", "ov-a", NS), source="api")
+        t0 = time.perf_counter()
+        self.nb_ready("ov-a")
+        resume_ms = round(1e3 * (time.perf_counter() - t0), 1)
+        restored = self.wait(
+            lambda: ((self.api.get("Notebook", "ov-a", NS)["metadata"]
+                      .get("annotations")) or {}).get(
+                nb_api.RESTORED_STEP_ANNOTATION),
+            what="ov-a restored step")
+        assert restored == "41", f"restored step {restored} != 41"
+        victims = [n for n in ("ov-b", "ov-c") if nb_api.SUSPEND_ANNOTATION
+                   in ((self.api.get("Notebook", n, NS)["metadata"]
+                        .get("annotations")) or {})]
+        assert len(victims) == 1, f"expected one victim, got {victims}"
+        walk_ann = (self.api.get("Notebook", "walk", NS)["metadata"]
+                    .get("annotations")) or {}
+        assert nb_api.SUSPEND_ANNOTATION not in walk_ann, \
+            "pinned notebook was preempted"
+        self.nb_ready("walk")
+        for name in names:
+            self.api.delete("Notebook", name, NS)
+        self.wait(lambda: not [
+            p for p in self.api.list("Pod", NS)
+            if (p["metadata"].get("labels") or {}).get(
+                nb_api.NOTEBOOK_NAME_LABEL) in names],
+            what="oversub pods swept")
+        return {"backfill_ms": backfill_ms, "resume_ms": resume_ms,
+                "victim": victims[0]}
+
     def delete_cascade(self):
         self.api.delete("Notebook", "walk", NS)
         gone = [("StatefulSet", "walk"), ("Service", "walk"),
@@ -472,6 +548,10 @@ class Walk:
         self.run("ha_failover", self.ha_failover,
                  skip=None if self.ha else
                  "needs the two-manager local backend")
+        self.run("oversubscription", self.oversubscription,
+                 skip=None if k else
+                 "needs the local backend (suspend controller + "
+                 "pod-status control)")
         self.run("delete_cascade", self.delete_cascade)
         return self.results
 
@@ -540,7 +620,11 @@ def local_backend(stop):
     def elected_manager(identity: str) -> dict:
         mstop = threading.Event()
         kapi = KubeAPIServer(rest.url, identity=identity)
-        mgr = make_cluster_manager(kapi, culler_config=culler_config)
+        # suspend lifecycle on, idle parking off: the oversubscription
+        # scenario drives suspends explicitly (the fast culler would
+        # otherwise race every idle window)
+        mgr = make_cluster_manager(kapi, culler_config=culler_config,
+                                   enable_suspend=True)
         elector = LeaderElector(
             kapi, identity,
             # scaled-down from the 15s/10s/2s production defaults so

@@ -1,0 +1,412 @@
+#!/usr/bin/env python3
+"""Chip-oversubscription conformance: 2x notebooks vs chips, all progress.
+
+NotebookOS's core claim is oversubscription through transparent
+suspend/resume: more notebooks than accelerators, with idle slices
+checkpointed and parked so every workload still makes progress. This
+harness proves that loop end-to-end on the in-process stack (the
+deterministic mode of ``spawn_conformance``): a fake TPU fleet, 2x as
+many notebooks as it has chips, all spawned through the REAL web API,
+then a demand storm — each round one notebook is "touched" (the
+readiness long-poll, i.e. real client demand), the rest idle out and
+the SuspendController parks them, freed chips re-gang waiting slices,
+and the touched notebook resumes with its checkpointed step restored
+exactly.
+
+Invariants asserted every round, on the backing store (not the cache):
+
+- **zero overcommit**: bound chips never exceed any node's capacity
+  (oversubscription is of *notebooks*, never of chips);
+- **progress**: every notebook becomes Ready repeatedly and its
+  training step advances (the bump stands in for the launcher agent);
+- **exactness**: after each resume ``RESTORED_STEP_ANNOTATION`` equals
+  the step the suspend-time snapshot recorded;
+- **priority**: the one high-priority notebook — spawned into a full
+  fleet — binds immediately by preempting exactly one victim.
+
+The artifact (``OVERSUB_r{N}.json``) carries suspend->resume latency
+percentiles (client wall time, in-process standin like
+``spawn_conformance``'s default mode) plus the server-side per-phase
+histogram and a chip-utilization-over-time series.
+
+``--no-oversubscribe`` is the A/B baseline arm: pin-for-lifetime.
+Notebooks beyond the fleet stay Pending forever, nobody is ever
+suspended or preempted, and the harness asserts exactly that.
+
+Usage:
+    python conformance/oversub_conformance.py --out OVERSUB_r01.json
+    python conformance/oversub_conformance.py --no-oversubscribe
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from kubeflow_rm_tpu.controlplane import (  # noqa: E402
+    make_control_plane, metrics, scheduler, suspend,
+)
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api.meta import (  # noqa: E402
+    annotations_of, deep_get, set_annotation,
+)
+from kubeflow_rm_tpu.controlplane.api.profile import make_profile  # noqa: E402
+from kubeflow_rm_tpu.controlplane.apiserver import Conflict  # noqa: E402
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (  # noqa: E402
+    make_tpu_node,
+)
+from kubeflow_rm_tpu.controlplane.webapps import jupyter as jwa  # noqa: E402
+
+NS = "oversub"
+USER = "oversub@corp.com"
+
+
+class FakeClock:
+    """Manually-advanced clock: idle windows elapse in fake minutes,
+    so the storm runs in CI seconds (suspend latency itself is measured
+    in client wall time, which the fake clock does not touch)."""
+
+    def __init__(self, start: str = "2026-01-01T00:00:00+00:00"):
+        self.now = datetime.datetime.fromisoformat(start)
+
+    def __call__(self) -> datetime.datetime:
+        return self.now
+
+    def advance(self, **timedelta_kwargs) -> None:
+        self.now = self.now + datetime.timedelta(**timedelta_kwargs)
+
+
+def _update_annotations(api, name, mutate):
+    """Read-modify-write a notebook's annotations with Conflict retry
+    (the storm races the controllers on the same map)."""
+    for attempt in range(8):
+        nb = api.get(nb_api.KIND, name, NS)
+        mutate(nb)
+        try:
+            return api.update(nb)
+        except Conflict:
+            if attempt == 7:
+                raise
+
+
+class Storm:
+    def __init__(self, args):
+        self.args = args
+        accel, count = args.slices.split(",")[0].split("=")
+        self.accel, self.slices = accel, int(count)
+        self.topo = tpu_api.lookup(accel)
+        self.n = args.notebooks or 2 * self.slices
+        self.clock = FakeClock()
+        suspend.set_oversubscribe(not args.no_oversubscribe)
+        suspend.set_state_store(suspend.InMemoryStateStore())
+        self.api, self.mgr = make_control_plane(
+            clock=self.clock, enable_suspend=True,
+            suspend_config={
+                "suspend_idle_minutes": args.idle_minutes,
+                "check_period_minutes": 1.0,
+            })
+        self.node_cap: dict[str, float] = {}
+        for s in range(self.slices):
+            for h in range(self.topo.hosts):
+                node = f"{accel}-s{s}-h{h}"
+                self.api.create(make_tpu_node(node, accel))
+                self.node_cap[node] = float(self.topo.chips_per_host)
+        self.capacity = sum(self.node_cap.values())
+        self.api.create(make_profile(NS, USER))
+        self.mgr.enqueue_all()
+        self.mgr.run_until_idle()
+        self.client = jwa.create_app(self.api).test_client(user=USER)
+        self.names = [f"ov-{i}" for i in range(self.n)]
+        self.high = self.names[-1]  # spawned last, into a full fleet
+        self.samples: list[dict] = []
+        self.resume_lat: list[float] = []
+        self.resumes_ok = 0
+
+    # ---- invariants ----------------------------------------------------
+    def check_overcommit(self):
+        """Ground truth from the backing store: per-node bound chips
+        never exceed the node's capacity. The whole point of the design
+        is oversubscribing notebooks, never chips."""
+        per_node: dict[str, float] = {}
+        for p in self.api.list("Pod", NS):
+            node = deep_get(p, "spec", "nodeName")
+            phase = deep_get(p, "status", "phase")
+            if not node or phase in scheduler.TERMINAL_PHASES:
+                continue
+            per_node[node] = per_node.get(node, 0.0) + \
+                scheduler._pod_chips(p)
+        for node, used in per_node.items():
+            cap = self.node_cap.get(node, 0.0)
+            assert used <= cap + 1e-9, \
+                f"OVERCOMMIT: node {node} has {used} chips bound, " \
+                f"capacity {cap}"
+        return sum(per_node.values())
+
+    def phases(self) -> dict[str, int]:
+        out = {"ready": 0, "suspended": 0, "pending": 0}
+        for name in self.names:
+            nb = self.api.get(nb_api.KIND, name, NS)
+            ann = annotations_of(nb)
+            if deep_get(nb, "status", "readyReplicas",
+                        default=0) == self.topo.hosts:
+                out["ready"] += 1
+            elif nb_api.SUSPEND_ANNOTATION in ann:
+                out["suspended"] += 1
+            else:
+                out["pending"] += 1
+        return out
+
+    def sample(self, tag: str):
+        bound = self.check_overcommit()
+        st = scheduler.cache_for(self.mgr.api).stats()
+        self.samples.append({
+            "t": self.clock().isoformat(),
+            "tag": tag,
+            "bound_chips": bound,
+            "capacity_chips": self.capacity,
+            "free_chips": st["free_chips"],
+            "largest_free_gang": st["largest_free_gang"],
+            "fragmentation": st["fragmentation"],
+            **self.phases(),
+        })
+
+    def ready(self, name: str) -> bool:
+        nb = self.api.get(nb_api.KIND, name, NS)
+        return deep_get(nb, "status", "readyReplicas",
+                        default=0) == self.topo.hosts
+
+    def drive_until_ready(self, name: str, ticks: int = 30):
+        for _ in range(ticks):
+            if self.ready(name):
+                return
+            self.check_overcommit()
+            self.clock.advance(minutes=1.0)
+            self.mgr.run_until_idle()
+        raise AssertionError(
+            f"{name} never became ready; phases={self.phases()}")
+
+    def bump_steps(self):
+        """Every Ready notebook trains: advance its durable step (the
+        launcher agent's TRAINING_STEP_ANNOTATION) by one."""
+        for name in self.names:
+            if not self.ready(name):
+                continue
+
+            def bump(nb):
+                ann = annotations_of(nb)
+                step = int(ann.get(
+                    nb_api.TRAINING_STEP_ANNOTATION) or 0) + 1
+                set_annotation(nb, nb_api.TRAINING_STEP_ANNOTATION,
+                               str(step))
+            _update_annotations(self.api, name, bump)
+
+    # ---- the storm -----------------------------------------------------
+    def spawn(self):
+        for name in self.names:
+            body = {
+                "name": name,
+                "image": "ghcr.io/kubeflow-rm-tpu/jupyter-jax:latest",
+                "imagePullPolicy": "IfNotPresent",
+                "serverType": "jupyter", "cpu": "2", "memory": "8Gi",
+                "tpu": {"acceleratorType": self.accel},
+                "tolerationGroup": "none", "affinityConfig": "none",
+                "configurations": [], "shm": True, "environment": {},
+                "datavols": [],
+            }
+            if name == self.high:
+                body["priorityClassName"] = "high"
+            resp = self.client.post(
+                f"/api/namespaces/{NS}/notebooks",
+                data=json.dumps(body),
+                headers=[("Content-Type", "application/json")])
+            assert resp.status_code == 200, resp.get_data()
+            self.mgr.run_until_idle()
+        self.sample("spawn")
+
+    def wake(self, name: str):
+        """Client demand on a suspended notebook: the readiness
+        long-poll's wake side effect (timeoutSeconds=0 so the in-process
+        client never blocks)."""
+        self.client.get(f"/api/namespaces/{NS}/notebooks/{name}"
+                        f"/readiness?timeoutSeconds=0")
+
+    def round(self, r: int):
+        target = self.names[r % self.n]
+        # the idle window elapses for everyone...
+        self.clock.advance(minutes=self.args.idle_minutes + 1.1)
+        nb = self.api.get(nb_api.KIND, target, NS)
+        ann = annotations_of(nb)
+        waking = (nb_api.SUSPEND_ANNOTATION in ann
+                  or nb_api.RESUME_REQUESTED_ANNOTATION in ann)
+        t0 = time.perf_counter()
+        if waking:
+            self.wake(target)
+        elif self.ready(target):
+            # ...except the touched one: fresh demand resets its clock
+            _update_annotations(
+                self.api, target,
+                lambda n: set_annotation(
+                    n, nb_api.LAST_ACTIVITY_ANNOTATION,
+                    self.clock().isoformat()))
+        self.mgr.run_until_idle()
+        self.drive_until_ready(target)
+        if waking:
+            self.resume_lat.append(time.perf_counter() - t0)
+            live = self.api.get(nb_api.KIND, target, NS)
+            a = annotations_of(live)
+            restored = a.get(nb_api.RESTORED_STEP_ANNOTATION)
+            trained = a.get(nb_api.TRAINING_STEP_ANNOTATION) or "0"
+            assert restored is not None, \
+                f"{target} resumed without a restored step"
+            assert int(restored) == int(trained), \
+                f"{target}: restored step {restored} != " \
+                f"pre-suspend step {trained}"
+            self.resumes_ok += 1
+        self.bump_steps()
+        self.sample(f"round-{r}")
+
+    def run_oversubscribed(self) -> dict:
+        self.spawn()
+        # the high-priority notebook hit a full fleet and must have
+        # preempted its way in: exactly one victim, all-or-nothing
+        assert self.ready(self.high), \
+            "high-priority notebook did not preempt into the full fleet"
+        preempts = metrics.registry_value("notebook_preempt_total")
+        assert preempts >= 1, f"no preemption recorded: {preempts}"
+        for r in range(self.args.rounds):
+            self.round(r)
+            print(f"round {r + 1}/{self.args.rounds}: "
+                  f"{self.samples[-1]['tag']} phases="
+                  f"{ {k: self.samples[-1][k] for k in ('ready', 'suspended', 'pending')} }",
+                  file=sys.stderr)
+        # every notebook made progress, repeatedly
+        steps = {}
+        for name in self.names:
+            nb = self.api.get(nb_api.KIND, name, NS)
+            steps[name] = int(annotations_of(nb).get(
+                nb_api.TRAINING_STEP_ANNOTATION) or 0)
+            assert steps[name] >= 2, \
+                f"{name} made no progress: step {steps[name]}"
+        assert self.resumes_ok >= self.n // 2, \
+            f"only {self.resumes_ok} suspend->resume cycles observed"
+        lat = sorted(self.resume_lat)
+        phase_hist = {}
+        for phase in ("drain", "rebind", "restore"):
+            phase_hist[phase] = {
+                "count": metrics.registry_value(
+                    "suspend_resume_phase_seconds_count",
+                    {"phase": phase}),
+                "sum_s": round(metrics.registry_value(
+                    "suspend_resume_phase_seconds_sum",
+                    {"phase": phase}), 4),
+            }
+        return {
+            "suspend_resume_ms": {
+                "count": len(lat),
+                "p50": round(lat[len(lat) // 2] * 1e3, 1),
+                "p95": round(
+                    lat[max(0, int(len(lat) * 0.95) - 1)] * 1e3, 1),
+                "max": round(lat[-1] * 1e3, 1),
+            },
+            "phase_seconds": phase_hist,
+            "progress_steps": steps,
+            "resumes_observed": self.resumes_ok,
+            "suspends_total": metrics.registry_value(
+                "notebook_suspend_total"),
+            "preemptions_total": metrics.registry_value(
+                "notebook_preempt_total"),
+        }
+
+    def run_baseline(self) -> dict:
+        """--no-oversubscribe: pin-for-lifetime preserved. The fleet
+        admits exactly its capacity, the overflow stays Pending whole,
+        and nobody is ever suspended or preempted no matter how idle."""
+        self.spawn()
+        ph = self.phases()
+        assert ph["ready"] == self.slices, \
+            f"baseline arm admitted {ph['ready']} != fleet {self.slices}"
+        assert not self.ready(self.high), \
+            "baseline arm let the high-priority notebook preempt"
+        for r in range(self.args.rounds):
+            self.clock.advance(minutes=10 * self.args.idle_minutes)
+            self.mgr.run_until_idle()
+            self.sample(f"round-{r}")
+        for name in self.names:
+            nb = self.api.get(nb_api.KIND, name, NS)
+            ann = annotations_of(nb)
+            assert nb_api.SUSPEND_ANNOTATION not in ann, \
+                f"{name} suspended in the no-oversubscribe arm"
+        ph = self.phases()
+        assert ph["ready"] == self.slices and ph["suspended"] == 0
+        assert metrics.registry_value("notebook_suspend_total") == 0
+        assert metrics.registry_value("notebook_preempt_total") == 0
+        return {
+            "suspend_resume_ms": {"count": 0},
+            "progress_steps": {},
+            "resumes_observed": 0,
+            "suspends_total": 0,
+            "preemptions_total": 0,
+            "pending_for_lifetime": ph["pending"],
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slices", default="v5p-16=2",
+                    help="acceleratorType=count fleet (first entry used)")
+    ap.add_argument("--notebooks", type=int, default=0,
+                    help="0 = 2x the fleet's slice capacity")
+    ap.add_argument("--rounds", type=int, default=12,
+                    help="demand-storm rounds (each touches one "
+                         "notebook and idles the rest out)")
+    ap.add_argument("--idle-minutes", type=float, default=5.0,
+                    help="SuspendController idle window (fake minutes)")
+    ap.add_argument("--no-oversubscribe", action="store_true",
+                    help="A/B baseline arm: pin-for-lifetime — no idle "
+                         "suspension, no preemption; overflow notebooks "
+                         "stay Pending")
+    ap.add_argument("--out", default="",
+                    help="also write the result JSON to this file "
+                         "(OVERSUB_r{N}.json artifact)")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    storm = Storm(args)
+    if args.no_oversubscribe:
+        detail = storm.run_baseline()
+    else:
+        detail = storm.run_oversubscribed()
+    storm.sample("final")
+
+    result = {
+        "arm": "no-oversubscribe" if args.no_oversubscribe
+               else "oversubscribe",
+        "slice": storm.accel,
+        "fleet_slices": storm.slices,
+        "hosts_per_slice": storm.topo.hosts,
+        "capacity_chips": storm.capacity,
+        "notebooks": storm.n,
+        "oversubscription_ratio": round(
+            storm.n / max(1, storm.slices), 2),
+        "rounds": args.rounds,
+        **detail,
+        "zero_overcommit": True,  # asserted per-node on every sample
+        "utilization": storm.samples,
+        "total_s": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(f"OVERSUB CONFORMANCE OK ({result['arm']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,7 @@ def wallclock_main(args) -> int:
         "lock": "global" if args.global_lock else "sharded",
         "writes": "serial" if args.serial_writes else "batched",
         "schedule": "legacy" if args.legacy_schedule else "cache",
+        "oversubscribe": not args.no_oversubscribe,
         "readiness": {
             "mode": "poll" if args.poll_readiness else "push",
             "status_get_requests": readiness["status_gets"],
@@ -457,6 +458,11 @@ def main() -> int:
                          "50ms status-GET polling instead of the "
                          "readiness long-poll — the push-readiness "
                          "A/B baseline arm (wallclock mode)")
+    ap.add_argument("--no-oversubscribe", action="store_true",
+                    help="pin-for-lifetime arm: disable idle "
+                         "suspension and preemptive gang-bind (the "
+                         "oversubscription A/B baseline — "
+                         "oversub_conformance.py is the full proof)")
     ap.add_argument("--hang-dump", type=float, default=0.0, metavar="S",
                     help="arm faulthandler to dump every thread's "
                          "stack after S seconds (CI contention-stress "
@@ -467,9 +473,10 @@ def main() -> int:
     args = ap.parse_args()
     # module-level switch: covers every Manager in this process (the
     # platform manager AND the wallclock kubelet both import runtime)
-    from kubeflow_rm_tpu.controlplane import runtime, scheduler
+    from kubeflow_rm_tpu.controlplane import runtime, scheduler, suspend
     runtime.set_serial_writes(args.serial_writes)
     scheduler.set_legacy_scan(args.legacy_schedule)
+    suspend.set_oversubscribe(not args.no_oversubscribe)
     if args.hang_dump > 0:
         # a deadlock in the sharded locking scheme must fail CI with
         # stacks, not eat the job's timeout silently
@@ -478,8 +485,11 @@ def main() -> int:
     if args.wallclock:
         return wallclock_main(args)
 
+    # suspend lifecycle controller on, idle parking off: explicit API
+    # suspends work, spawn-path behavior is otherwise unchanged
     api, mgr = make_control_plane(cache=not args.no_cache,
-                                  global_lock=args.global_lock)
+                                  global_lock=args.global_lock,
+                                  enable_suspend=True)
 
     # fake fleet: enough hosts for every requested slice
     pools = []
@@ -544,12 +554,60 @@ def main() -> int:
             assert ready == 0, f"conf-{i}: rump slice with {ready} ready"
 
     total = time.perf_counter() - t_start
+
+    # suspend->resume cycle: park each admitted slice through the API
+    # arm (PATCH suspended) and measure request->Ready resume latency.
+    # Skipped when the fleet is exhausted: a drained slice's chips are
+    # immediately re-ganged by the Pending overflow (by design — the
+    # oversubscription loop itself is proven in oversub_conformance.py),
+    # so the resume would block on capacity, not on the lifecycle.
+    resume_lat: list[float] = []
+    admitted = [
+        f"conf-{i}" for i in range(args.notebooks)
+        if api.get(nb_api.KIND, f"conf-{i}", "conformance")
+        .get("status", {}).get("readyReplicas", 0) == topo.hosts]
+    if len(admitted) == args.notebooks:
+        for name in admitted:
+            url = f"/api/namespaces/conformance/notebooks/{name}"
+            hdrs = [("Content-Type", "application/json")]
+            resp = client.patch(url, data=json.dumps({"suspended": True}),
+                                headers=hdrs)
+            assert resp.status_code == 200, resp.get_data()
+            mgr.run_until_idle()
+            nb = api.get(nb_api.KIND, name, "conformance")
+            assert nb.get("status", {}).get("phase") == \
+                nb_api.SUSPENDED_PHASE, nb.get("status")
+            t0 = time.perf_counter()
+            resp = client.patch(url, data=json.dumps({"suspended": False}),
+                                headers=hdrs)
+            assert resp.status_code == 200, resp.get_data()
+            for _ in range(20):
+                mgr.run_until_idle()
+                nb = api.get(nb_api.KIND, name, "conformance")
+                if nb.get("status", {}).get(
+                        "readyReplicas", 0) == topo.hosts:
+                    break
+            else:
+                raise AssertionError(f"{name} never resumed")
+            resume_lat.append(time.perf_counter() - t0)
+    resume_lat.sort()
+    suspend_resume = {"count": len(resume_lat)}
+    if resume_lat:
+        suspend_resume.update(
+            resume_p50_ms=round(
+                resume_lat[len(resume_lat) // 2] * 1e3, 1),
+            resume_p95_ms=round(
+                resume_lat[max(0, int(len(resume_lat) * 0.95) - 1)]
+                * 1e3, 1))
+
     p50 = sorted(t for t, _ in latencies)[len(latencies) // 2]
     print(json.dumps({
         "notebooks": args.notebooks,
         "slice": accel,
         "hosts_per_slice": topo.hosts,
+        "oversubscribe": not args.no_oversubscribe,
         "provision_p50_ms": round(p50 * 1e3, 1),
+        "suspend_resume": suspend_resume,
         "total_s": round(total, 2),
         "reconciles_per_spawn": [r for _, r in latencies],
     }))

@@ -20,7 +20,9 @@ from kubeflow_rm_tpu.controlplane.apiserver import APIServer
 def make_control_plane(clock=None, *, auto_ready: bool = True,
                        enable_culling: bool = False,
                        culler_config=None, cache: bool = True,
-                       global_lock: bool = False):
+                       global_lock: bool = False,
+                       enable_suspend: bool = False,
+                       suspend_config=None):
     """Build (api, manager) with every controller and webhook wired.
 
     ``clock`` is injectable for deterministic culling tests;
@@ -28,7 +30,10 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     ``cache=False`` runs the manager on the raw verb surface (the A/B
     baseline arm of ``spawn_conformance --no-cache``);
     ``global_lock=True`` restores the pre-r08 single-RLock apiserver
-    with synchronous watch delivery (the ``--global-lock`` A/B arm).
+    with synchronous watch delivery (the ``--global-lock`` A/B arm);
+    ``enable_suspend=True`` adds the suspend/resume lifecycle
+    controller (``suspend_config`` → ``SuspendController`` kwargs, e.g.
+    ``{"suspend_idle_minutes": 30}`` to park idle slices).
     """
     from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
     from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
@@ -100,11 +105,16 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     manager.add(PVCViewerController())
     if enable_culling:
         manager.add(CullingController(**(culler_config or {})))
+    if enable_suspend:
+        from kubeflow_rm_tpu.controlplane.suspend import SuspendController
+        manager.add(SuspendController(**(suspend_config or {})))
     return api, manager
 
 
 def make_cluster_manager(api, *, enable_culling: bool = True,
-                         culler_config=None):
+                         culler_config=None,
+                         enable_suspend: bool = False,
+                         suspend_config=None):
     """Controller wiring for a REAL cluster (``deploy.kubeclient``):
     same reconcilers as ``make_control_plane`` minus the pieces a real
     cluster provides itself — the StatefulSet/Deployment controllers
@@ -157,6 +167,9 @@ def make_cluster_manager(api, *, enable_culling: bool = True,
     manager.add(PVCViewerController())
     if enable_culling:
         manager.add(CullingController(**(culler_config or {})))
+    if enable_suspend:
+        from kubeflow_rm_tpu.controlplane.suspend import SuspendController
+        manager.add(SuspendController(**(suspend_config or {})))
     return manager
 
 

@@ -43,6 +43,39 @@ CULLING_EXCLUDE_ANNOTATION = "kubeflow-resource-culling-excluded"
 # TPU-native additions
 TPU_INJECT_EXCLUDE_ANNOTATION = "notebooks.kubeflow.org/tpu-inject-exclude"
 
+# --- suspend/resume lifecycle (controlplane/suspend.py) ---------------
+# Distinct from STOP_ANNOTATION: a *stopped* notebook stays down until a
+# user restarts it; a *suspended* one released its chips to the pool and
+# transparently resumes on the next incoming request. Value = ISO
+# timestamp of the suspend decision (drives per-phase latency metrics).
+SUSPEND_ANNOTATION = "notebooks.kubeflow.org/suspended"
+# why the slice was parked: "idle" | "preempted" | "api"
+SUSPEND_REASON_ANNOTATION = "notebooks.kubeflow.org/suspend-reason"
+# JSON token from the Checkpointer-backed state store, written at
+# suspend time; resume restores against it and stamps restored-step
+SUSPEND_CHECKPOINT_ANNOTATION = "notebooks.kubeflow.org/suspend-checkpoint"
+# ISO timestamp the slice finished draining (set once per suspend cycle)
+SUSPEND_DRAINED_ANNOTATION = "notebooks.kubeflow.org/suspend-drained"
+# ISO timestamp of the first resume-triggering request (earliest wins —
+# the suspend→resume latency clock starts here)
+RESUME_REQUESTED_ANNOTATION = "notebooks.kubeflow.org/resume-requested"
+# step the state store restored on the last resume (proof of exactness)
+RESTORED_STEP_ANNOTATION = "notebooks.kubeflow.org/restored-step"
+# the workload's durable training step (maintained by the in-notebook
+# launcher agent; the state store snapshots it at suspend time)
+TRAINING_STEP_ANNOTATION = "notebooks.kubeflow.org/training-step"
+# pin: never suspend, never select as a preemption victim, never cull
+PIN_ANNOTATION = "tpu.kubeflow.org/do-not-suspend"
+
+#: the lifecycle phase a drained suspended notebook reports
+SUSPENDED_PHASE = "Suspended"
+
+#: named priority classes for spec.priorityClassName; higher wins.
+#: Absent spec → "default", so pre-oversubscription notebooks neither
+#: preempt nor outrank anything they didn't before.
+PRIORITY_CLASSES = {"low": 0, "default": 100, "high": 1000}
+DEFAULT_PRIORITY = PRIORITY_CLASSES["default"]
+
 # label the controller stamps on everything it renders
 NOTEBOOK_NAME_LABEL = "notebook-name"
 # pod label carrying the slice's accelerator type (webhook + web apps read it)
@@ -56,6 +89,7 @@ def make_notebook(name: str, namespace: str, *,
                   image: str = "jupyter-jax:latest",
                   accelerator_type: str | None = None,
                   num_slices: int = 1,
+                  priority_class: str | None = None,
                   labels: dict | None = None,
                   annotations: dict | None = None,
                   pod_spec_extra: dict | None = None,
@@ -77,6 +111,8 @@ def make_notebook(name: str, namespace: str, *,
         spec["tpu"] = {"acceleratorType": accelerator_type}
         if num_slices != 1:
             spec["tpu"]["numSlices"] = num_slices
+    if priority_class is not None:
+        spec["priorityClassName"] = priority_class
     return make_object(API_VERSION, KIND, name, namespace,
                        labels=labels, annotations=annotations, spec=spec)
 
@@ -109,6 +145,33 @@ def total_hosts(notebook: dict) -> int:
     return topo.hosts * num_slices(notebook)
 
 
+def priority_of(notebook: dict) -> int:
+    """Effective scheduling priority: an explicit integer
+    ``spec.priority`` wins; else ``spec.priorityClassName`` resolved
+    through PRIORITY_CLASSES; else DEFAULT_PRIORITY. Preemption only
+    ever displaces a *strictly lower* priority, so all-default fleets
+    keep today's first-come-first-served behavior."""
+    p = deep_get(notebook, "spec", "priority")
+    if p is not None:
+        try:
+            return int(p)
+        except (TypeError, ValueError):
+            return DEFAULT_PRIORITY
+    cls = deep_get(notebook, "spec", "priorityClassName")
+    return PRIORITY_CLASSES.get(cls, DEFAULT_PRIORITY)
+
+
+def is_pinned(notebook: dict) -> bool:
+    """Pinned notebooks hold their slice for the notebook's lifetime:
+    skipped by idle culling, idle suspension, and preemption victim
+    selection. Presence-based like the stop annotation (any value but
+    an explicit \"false\")."""
+    ann = (notebook["metadata"].get("annotations") or {})
+    if PIN_ANNOTATION not in ann:
+        return False
+    return str(ann.get(PIN_ANNOTATION)).lower() != "false"
+
+
 def validate(notebook: dict) -> None:
     """Structural validation (the CRD schema's job in the reference)."""
     containers = deep_get(notebook, "spec", "template", "spec", "containers")
@@ -124,3 +187,11 @@ def validate(notebook: dict) -> None:
         if not isinstance(ns, int) or ns < 1 or ns > MAX_SLICES:
             raise ValueError(
                 f"spec.tpu.numSlices must be an int in [1, {MAX_SLICES}]")
+    cls = deep_get(notebook, "spec", "priorityClassName")
+    if cls is not None and cls not in PRIORITY_CLASSES:
+        raise ValueError(
+            f"spec.priorityClassName must be one of "
+            f"{sorted(PRIORITY_CLASSES)}, got {cls!r}")
+    p = deep_get(notebook, "spec", "priority")
+    if p is not None and not isinstance(p, int):
+        raise ValueError("spec.priority must be an integer")

@@ -99,6 +99,10 @@ class CullingController(Controller):
             return None  # already stopped: nothing to cull
         if ann.get(nb_api.CULLING_EXCLUDE_ANNOTATION) == "true":
             return None
+        if nb_api.SUSPEND_ANNOTATION in ann:
+            return None  # suspended: chips already released, nothing to cull
+        if nb_api.is_pinned(notebook):
+            return None  # pinned: holds its slice for the notebook's lifetime
         requeue = self.check_period.total_seconds()
 
         pod0 = api.try_get("Pod", f"{req.name}-0", req.namespace)

@@ -122,8 +122,13 @@ class NotebookController(Controller):
         # ordinal // hosts-per-slice); the webhook derives per-slice
         # rendezvous + MEGASCALE_* DCN env from the labels below
         hosts = nb_api.total_hosts(notebook)
-        stopped = nb_api.STOP_ANNOTATION in annotations_of(notebook)
-        replicas = 0 if stopped else hosts
+        ann = annotations_of(notebook)
+        # parked = user-stopped OR suspended (chips released to the
+        # pool): both render to zero replicas; the difference is who
+        # brings them back (a user vs. any incoming request)
+        parked = (nb_api.STOP_ANNOTATION in ann
+                  or nb_api.SUSPEND_ANNOTATION in ann)
+        replicas = 0 if parked else hosts
 
         pod_spec = fast_deepcopy(
             deep_get(notebook, "spec", "template", "spec", default={}))
@@ -264,11 +269,16 @@ class NotebookController(Controller):
         sts = api.try_get("StatefulSet", name, ns)
         ready = deep_get(sts, "status", "readyReplicas", default=0) if sts \
             else 0
+        ann = annotations_of(notebook)
+        parked = (nb_api.STOP_ANNOTATION in ann
+                  or nb_api.SUSPEND_ANNOTATION in ann)
         status: dict = {
             "readyReplicas": ready,
-            "desiredReplicas": 0 if nb_api.STOP_ANNOTATION in
-            annotations_of(notebook) else hosts,
+            "desiredReplicas": 0 if parked else hosts,
         }
+        if (nb_api.SUSPEND_ANNOTATION in ann
+                and nb_api.SUSPEND_DRAINED_ANNOTATION in ann):
+            status["phase"] = nb_api.SUSPENDED_PHASE
         pod0 = api.try_get("Pod", f"{name}-0", ns)
         if pod0:
             cs = deep_get(pod0, "status", "containerStatuses", 0)

@@ -324,8 +324,14 @@ class StatefulSetController(Controller):
             unbound.append(pod)
         if not unbound:
             return
-        plan = sched.gang_bind(
-            unbound, allow_virtual=self._allow_virtual(api))
+        allow_virtual = self._allow_virtual(api)
+        plan = sched.gang_bind(unbound, allow_virtual=allow_virtual)
+        if plan is None:
+            # priority preemption: suspend strictly lower-priority
+            # victim slices and retry the gang in this same reconcile
+            from kubeflow_rm_tpu.controlplane import suspend
+            plan = suspend.try_preempt(api, sts, unbound, sched,
+                                       allow_virtual=allow_virtual)
         if plan is None:
             for pod in unbound:
                 self._mark_unschedulable(api, pod)

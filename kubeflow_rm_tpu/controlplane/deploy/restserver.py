@@ -258,6 +258,12 @@ class RestServer:
             # fanout gauges etc. scraped over the same socket the
             # conformance harness already talks to
             from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+            from kubeflow_rm_tpu.controlplane import (
+                scheduler as cp_scheduler,
+            )
+            # free-chip/fragmentation gauges are recomputed on stats();
+            # refresh so a scrape between binds reads the live pool
+            cp_scheduler.refresh_gauges()
             self._send_raw(handler, 200, cp_metrics.scrape(),
                            content_type="text/plain; version=0.0.4")
             return

@@ -237,6 +237,53 @@ READINESS_WAITERS = Gauge(
     registry=REGISTRY,
 )
 
+# ---- oversubscription: suspend/resume lifecycle + preemption ---------
+SCHEDULER_FREE_CHIPS = Gauge(
+    "scheduler_free_chips",
+    "Unclaimed TPU chips across the tracked node fleet (capacity minus "
+    "charged usage in the scheduler cache)",
+    registry=REGISTRY,
+)
+SCHEDULER_LARGEST_FREE_GANG = Gauge(
+    "scheduler_largest_free_gang_chips",
+    "Largest slice placeable as one gang of identical hosts given the "
+    "current free-chip distribution (ParvaGPU's largest allocatable "
+    "unit) — free_chips minus this is stranded capacity",
+    registry=REGISTRY,
+)
+SCHEDULER_FRAGMENTATION = Gauge(
+    "scheduler_fragmentation",
+    "Bin-packing fragmentation gauge: 1 - largest_free_gang/free_chips "
+    "(0 = all free capacity gang-placeable, 1 = fully stranded)",
+    registry=REGISTRY,
+)
+NOTEBOOK_SUSPEND_TOTAL = Counter(
+    "notebook_suspend_total",
+    "Notebooks driven to Suspended, by reason (idle | preempted | api)",
+    ["reason"],
+    registry=REGISTRY,
+)
+NOTEBOOK_RESUME_TOTAL = Counter(
+    "notebook_resume_total",
+    "Suspended notebooks resumed back to Running with state restored",
+    registry=REGISTRY,
+)
+NOTEBOOK_PREEMPT_TOTAL = Counter(
+    "notebook_preempt_total",
+    "Victim slices suspended by the preemptive gang-bind path so a "
+    "higher-priority slice could bind all-or-nothing",
+    registry=REGISTRY,
+)
+SUSPEND_RESUME_SECONDS = Histogram(
+    "suspend_resume_phase_seconds",
+    "Suspend/resume lifecycle latency per phase: drain (suspend "
+    "decision -> slice fully scaled to zero), rebind (resume request "
+    "-> slice ready again), restore (state-store restore call)",
+    ["phase"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0),
+    registry=REGISTRY,
+)
+
 
 def registry_value(sample_name: str,
                    labels: dict[str, str] | None = None) -> float:

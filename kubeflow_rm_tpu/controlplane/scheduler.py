@@ -380,6 +380,25 @@ class SchedulerCache:
             metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
         self._adjust((e.node, e.chips), None)
 
+    def release(self, key: tuple) -> None:
+        """Out-of-band eviction for suspend/preemption teardown: the
+        caller just deleted the pod and needs its chips free NOW, not
+        after the DELETE event clears the async fanout — a preemptive
+        gang-bind retries synchronously in the same reconcile. Unlike
+        ``forget`` this drops confirmed entries too. The later DELETE
+        echo folds in as a no-op; a stale pre-delete UPDATE still in
+        the queue can transiently re-charge until its DELETE lands —
+        that converges and can only under-admit, never over-commit."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        with self._plock:
+            e = self._pods.pop(key, None)
+            if e is None:
+                return
+            if e.rv is _ASSUMED:
+                self._assumed -= 1
+                metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
+        self._adjust((e.node, e.chips), None)
+
     # -- read-side helpers ---------------------------------------------
     def total_used(self) -> float:
         """Chips currently charged across the fleet — O(nodes), serves
@@ -400,13 +419,49 @@ class SchedulerCache:
         with node.lock:
             return node.used
 
+    def free_by_node(self) -> dict[str, tuple[float, dict]]:
+        """Snapshot of ``{node: (free_chips, labels)}`` — the read side
+        preemption simulates victim teardown against."""
+        with self._nlock:
+            nodes = list(self._nodes.values())
+        out: dict[str, tuple[float, dict]] = {}
+        for node in nodes:
+            with node.lock:
+                free = max(0.0, node.capacity - node.used)
+            out[node.name] = (free, node.labels)
+        return out
+
     def stats(self) -> dict:
+        """Cache counters plus the bin-packing view: ``free_chips``
+        (total unclaimed capacity), ``largest_free_gang`` (the biggest
+        slice placeable as a gang of identical hosts — max over c of
+        c × |{nodes with ≥ c chips free}|, ParvaGPU's "largest
+        allocatable unit"), and ``fragmentation`` = 1 − largest/free
+        (0 when free chips are gang-placeable whole, → 1 as free
+        capacity shatters into unusable crumbs). Refreshes the
+        matching Prometheus gauges as a side effect."""
+        from kubeflow_rm_tpu.controlplane import metrics
         with self._plock:
             pods, assumed = len(self._pods), self._assumed
         with self._nlock:
-            nodes = len(self._nodes)
-        return {"nodes": nodes, "pods": pods, "assumed": assumed,
-                "stale": self._stale}
+            nodes = list(self._nodes.values())
+        free: list[float] = []
+        for node in nodes:
+            with node.lock:
+                free.append(max(0.0, node.capacity - node.used))
+        free_chips = sum(free)
+        largest = 0.0
+        for i, f in enumerate(sorted(free, reverse=True)):
+            if f <= 0:
+                break
+            largest = max(largest, f * (i + 1))
+        frag = 0.0 if free_chips <= 0 else 1.0 - largest / free_chips
+        metrics.SCHEDULER_FREE_CHIPS.set(free_chips)
+        metrics.SCHEDULER_LARGEST_FREE_GANG.set(largest)
+        metrics.SCHEDULER_FRAGMENTATION.set(frag)
+        return {"nodes": len(nodes), "pods": pods, "assumed": assumed,
+                "stale": self._stale, "free_chips": free_chips,
+                "largest_free_gang": largest, "fragmentation": frag}
 
 
 # ---- per-backend cache registry + the legacy A/B switch --------------
@@ -427,6 +482,16 @@ def set_legacy_scan(enabled: bool) -> None:
 
 def legacy_scan() -> bool:
     return _legacy_scan
+
+
+def refresh_gauges() -> None:
+    """Recompute the free-chips/fragmentation gauges for every live
+    cache — called by text-scrape endpoints (``deploy/restserver.py``
+    ``/metrics``) so the exposition reflects now, not the last bind."""
+    with _caches_lock:
+        caches = list(_caches.values())
+    for cache in caches:
+        cache.stats()
 
 
 def cache_for(api) -> SchedulerCache:

@@ -1,0 +1,633 @@
+"""Suspend/resume lifecycle + priority preemption: chip oversubscription.
+
+NotebookOS (arxiv 2503.20591) allocates accelerators to interactive
+notebooks *on demand*: a notebook between bursts checkpoints its state
+and releases its devices, and any incoming request transparently
+restores it. This module is that loop for TPU slices, composed from
+pieces the platform already had:
+
+- **Suspend** (``SuspendController`` + ``initiate_suspend``): snapshot
+  the notebook's training state through a Checkpointer-backed state
+  store, stamp ``SUSPEND_ANNOTATION`` — the notebook controller renders
+  the StatefulSet to zero replicas exactly as it does for the stop
+  annotation, the fake kubelet deletes the ordinal pods, and the
+  scheduler cache gives the chips back (``release()`` short-circuits
+  the watch-event lag so a waiting gang can bind in the same reconcile).
+- **Resume** (``request_resume`` + the controller's rebind half): any
+  incoming request — the jupyter readiness long-poll, a PATCH, a log
+  fetch — clears the suspend annotation and stamps
+  ``RESUME_REQUESTED_ANNOTATION``; the StatefulSet scales back up,
+  ``gang_bind`` re-gangs the slice (anywhere it fits — slices are
+  location-transparent), the state store restores the checkpoint token,
+  and the push-readiness hub wakes the blocked client. Latency is
+  recorded per phase (drain / rebind / restore).
+- **Preemption** (``try_preempt``): when a higher-priority gang cannot
+  bind, pick victim slices — lowest priority first, then longest idle,
+  then best fragmentation fit — suspend them through the same
+  lifecycle, delete their pods (kube-scheduler's preemption deletes
+  victims directly), and bind the newcomer all-or-nothing.
+
+The ``--no-oversubscribe`` arm (``set_oversubscribe(False)``) restores
+pin-for-lifetime behavior: no idle suspension, no preemption.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import time
+from typing import Callable
+
+from kubeflow_rm_tpu.controlplane import metrics, scheduler
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    annotations_of,
+    deep_get,
+    name_of,
+    namespace_of,
+    set_annotation,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import (
+    APIServer, Conflict, NotFound,
+)
+from kubeflow_rm_tpu.controlplane.runtime import (
+    Controller, Request, map_by_label,
+)
+
+DEFAULT_CHECK_PERIOD_MIN = 1.0
+
+# annotation bumped on pending pods to requeue their owner StatefulSet
+# when a drain returns chips to the pool (see kick_pending_pods)
+_KICK_ANNOTATION = "notebooks.kubeflow.org/reschedule-kick"
+
+
+# ---- the oversubscription A/B switch ---------------------------------
+
+_oversubscribe = True
+
+
+def set_oversubscribe(enabled: bool) -> None:
+    """``--no-oversubscribe`` arm: keep today's pin-for-lifetime
+    behavior — no idle suspension, no preemptive gang-bind."""
+    global _oversubscribe
+    _oversubscribe = bool(enabled)
+
+
+def oversubscribe() -> bool:
+    return _oversubscribe
+
+
+# ---- Checkpointer-backed state stores --------------------------------
+
+class InMemoryStateStore:
+    """Default state store: holds each notebook's snapshot payload in
+    process memory, keyed (namespace, name). The snapshot records the
+    workload's durable training step (the launcher agent maintains
+    ``TRAINING_STEP_ANNOTATION``); restore hands the payload back so
+    the controller can prove exactness via ``RESTORED_STEP_ANNOTATION``."""
+
+    def __init__(self):
+        self._saved: dict[tuple, dict] = {}
+
+    def snapshot(self, notebook: dict) -> dict:
+        ann = annotations_of(notebook)
+        try:
+            step = int(ann.get(nb_api.TRAINING_STEP_ANNOTATION) or 0)
+        except (TypeError, ValueError):
+            step = 0
+        token = {"step": step}
+        self._saved[(namespace_of(notebook), name_of(notebook))] = token
+        return dict(token)
+
+    def restore(self, notebook: dict, token: dict | None) -> dict | None:
+        saved = self._saved.get(
+            (namespace_of(notebook), name_of(notebook)))
+        if saved is None:
+            return dict(token) if token else None
+        return dict(saved)
+
+
+class CheckpointerStateStore:
+    """State store bridged to ``training/checkpoint.py``: each notebook
+    workspace has a Checkpointer-compatible manager (``latest_step()``,
+    optionally ``wait()``). Suspend records the last *durable* step —
+    the slice can be torn down because training resumes exactly there;
+    resume verifies the checkpoint still holds a step ≥ the token's.
+
+    ``manager_for(namespace, name)`` is injected so deployments map
+    notebooks to their PVC/GCS checkpoint directories and tests pass
+    fakes or real orbax ``Checkpointer`` instances."""
+
+    def __init__(self, manager_for: Callable[[str, str], object]):
+        self._manager_for = manager_for
+
+    def snapshot(self, notebook: dict) -> dict:
+        mgr = self._manager_for(namespace_of(notebook), name_of(notebook))
+        wait = getattr(mgr, "wait", None)
+        if wait is not None:
+            wait()  # pending async saves must be durable before teardown
+        step = mgr.latest_step()
+        return {"step": int(step) if step is not None else 0}
+
+    def restore(self, notebook: dict, token: dict | None) -> dict | None:
+        mgr = self._manager_for(namespace_of(notebook), name_of(notebook))
+        step = mgr.latest_step()
+        restored = {"step": int(step) if step is not None else 0}
+        want = (token or {}).get("step")
+        if want is not None and restored["step"] < want:
+            # checkpoint regressed under us (GC raced, storage lost a
+            # write): restore the best durable step and say which
+            restored["degraded_from"] = want
+        return restored
+
+
+_state_store = InMemoryStateStore()
+
+
+def set_state_store(store) -> None:
+    """Swap the module-default state store (conformance wires a
+    CheckpointerStateStore; tests reset to a fresh InMemoryStateStore)."""
+    global _state_store
+    _state_store = store
+
+
+def state_store():
+    return _state_store
+
+
+# ---- lifecycle verbs (shared by controller, webapp, preemption) ------
+
+def _update_retrying(api: APIServer, notebook: dict,
+                     mutate: Callable[[dict], bool]) -> dict:
+    """Apply ``mutate`` (returns False to abort) and update, retrying
+    the read-modify-write on Conflict — suspend races the culler and
+    the webapp on the same annotations map. Always starts from a fresh
+    ``get()`` copy: callers may hold ``scan()`` store references, and
+    mutating those in place would make the write a self-comparing
+    no-op under the cache's suppression."""
+    notebook = api.get(nb_api.KIND, name_of(notebook),
+                       namespace_of(notebook))
+    for _ in range(8):
+        if not mutate(notebook):
+            return notebook
+        try:
+            return api.update(notebook)
+        except Conflict:
+            notebook = api.get(nb_api.KIND, name_of(notebook),
+                               namespace_of(notebook))
+    raise Conflict(f"could not update notebook {name_of(notebook)} "
+                   "after 8 attempts")
+
+
+def initiate_suspend(api: APIServer, notebook: dict, *,
+                     reason: str, store=None) -> dict:
+    """Drive a notebook into the Suspended lifecycle: snapshot state,
+    stamp the suspend annotations (the notebook controller scales the
+    StatefulSet to zero from them), emit the event. Idempotent."""
+    store = store if store is not None else _state_store
+    token_box: list = []
+
+    def mutate(nb: dict) -> bool:
+        ann = annotations_of(nb)
+        if nb_api.SUSPEND_ANNOTATION in ann:
+            return False  # already suspending/suspended
+        if not token_box:
+            token_box.append(store.snapshot(nb))
+        set_annotation(nb, nb_api.SUSPEND_ANNOTATION,
+                       api.clock().isoformat())
+        set_annotation(nb, nb_api.SUSPEND_REASON_ANNOTATION, reason)
+        set_annotation(nb, nb_api.SUSPEND_CHECKPOINT_ANNOTATION,
+                       json.dumps(token_box[0]))
+        # a fresh cycle: clear residue from any previous one
+        ann.pop(nb_api.SUSPEND_DRAINED_ANNOTATION, None)
+        ann.pop(nb_api.RESUME_REQUESTED_ANNOTATION, None)
+        return True
+
+    live = _update_retrying(api, notebook, mutate)
+    if token_box:  # we actually initiated (not a no-op)
+        api.record_event(
+            live, "Normal", "Suspending",
+            f"suspending slice ({reason}); checkpoint token "
+            f"{json.dumps(token_box[0])} — chips return to the pool, "
+            "the notebook resumes on next request")
+        metrics.NOTEBOOK_SUSPEND_TOTAL.labels(reason=reason).inc()
+    return live
+
+
+def request_resume(api: APIServer, notebook: dict, *,
+                   source: str = "request") -> dict:
+    """Flip a suspended notebook back toward Running: clear the suspend
+    annotation (the StatefulSet scales back up and re-gangs) and stamp
+    the resume-request time — earliest stamp wins, it is the latency
+    clock the rebind phase is measured against. Idempotent."""
+    acted: list = []
+
+    def mutate(nb: dict) -> bool:
+        ann = annotations_of(nb)
+        if nb_api.SUSPEND_ANNOTATION not in ann:
+            return False  # not suspended (or resume already in flight)
+        ann.pop(nb_api.SUSPEND_ANNOTATION, None)
+        if nb_api.RESUME_REQUESTED_ANNOTATION not in ann:
+            set_annotation(nb, nb_api.RESUME_REQUESTED_ANNOTATION,
+                           api.clock().isoformat())
+        acted.append(True)
+        return True
+
+    live = _update_retrying(api, notebook, mutate)
+    if acted:
+        api.record_event(
+            live, "Normal", "Resuming",
+            f"resume requested ({source}); re-ganging the slice and "
+            "restoring checkpointed state")
+    return live
+
+
+def kick_pending_pods(api: APIServer, *, now: str) -> None:
+    """Requeue every slice still waiting for chips: freed capacity
+    doesn't emit an event any controller watches, so after a drain we
+    bump an annotation on each unbound Pending pod — its update event
+    maps to the owning StatefulSet, whose reconcile retries the
+    gang-bind. Best-effort: a lost kick is recovered by the next drain
+    or the long-poll's periodic backstop."""
+    scan = getattr(api, "scan", api.list)
+    for p in scan("Pod"):
+        if deep_get(p, "spec", "nodeName"):
+            continue
+        if deep_get(p, "status", "phase") not in (None, "Pending"):
+            continue
+        pod = api.try_get("Pod", name_of(p), namespace_of(p))
+        if pod is None:
+            continue
+        set_annotation(pod, _KICK_ANNOTATION, now)
+        try:
+            api.update(pod)
+        except (Conflict, NotFound):
+            pass
+
+
+# ---- the controller --------------------------------------------------
+
+class SuspendController(Controller):
+    """Owns both halves of the lifecycle.
+
+    Suspend half: once a suspend-annotated notebook's pods are gone,
+    release any cache residue, stamp the drained timestamp, observe the
+    drain latency. With ``suspend_idle_minutes`` set it also *initiates*
+    suspension for idle notebooks (last-activity / worker-0 start,
+    same clock the culler uses) — a gentler tier below culling.
+
+    Resume half: when a resume-requested notebook is ready again,
+    restore the state store token, stamp ``RESTORED_STEP_ANNOTATION``,
+    observe rebind+restore latency. Pod events requeue it (same label
+    watch as the notebook controller), so the loop is event-driven —
+    deterministic under ``run_until_idle`` with an injected clock.
+    """
+
+    kind = nb_api.KIND
+
+    def __init__(self, suspend_idle_minutes: float | None = None,
+                 check_period_minutes: float = DEFAULT_CHECK_PERIOD_MIN,
+                 store=None):
+        self.suspend_idle = (
+            datetime.timedelta(minutes=suspend_idle_minutes)
+            if suspend_idle_minutes is not None else None)
+        self.check_period = datetime.timedelta(minutes=check_period_minutes)
+        self._store = store
+
+    @property
+    def store(self):
+        return self._store if self._store is not None else _state_store
+
+    def watches(self):
+        return (("Pod", map_by_label(nb_api.NOTEBOOK_NAME_LABEL)),)
+
+    def reconcile(self, api: APIServer, req: Request):
+        try:
+            notebook = api.get(nb_api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if notebook["metadata"].get("deletionTimestamp"):
+            return None
+        ann = annotations_of(notebook)
+        if nb_api.STOP_ANNOTATION in ann:
+            return None  # user-stopped: the stop lifecycle owns it
+        if nb_api.SUSPEND_ANNOTATION in ann:
+            return self._reconcile_suspending(api, notebook)
+        if nb_api.RESUME_REQUESTED_ANNOTATION in ann:
+            return self._reconcile_resuming(api, notebook)
+        return self._maybe_suspend_idle(api, notebook)
+
+    # -- suspend half --------------------------------------------------
+    def _reconcile_suspending(self, api: APIServer, notebook: dict):
+        ann = annotations_of(notebook)
+        if nb_api.SUSPEND_DRAINED_ANNOTATION in ann:
+            return None  # drained and parked; resume is event-driven
+        name, ns = name_of(notebook), namespace_of(notebook)
+        pods = [p for p in api.list("Pod", ns)
+                if (p["metadata"].get("labels") or {}).get(
+                    nb_api.NOTEBOOK_NAME_LABEL) == name]
+        if pods:
+            return None  # scale-down in flight; pod deletes requeue us
+        # drained: purge any cache residue (assumed binds whose delete
+        # events haven't cleared the fanout) so the pool sees the chips
+        if not scheduler.legacy_scan():
+            sched = scheduler.cache_for(api)
+            for i in range(nb_api.total_hosts(notebook)):
+                sched.release((ns, f"{name}-{i}"))
+        now = api.clock()
+
+        def mutate(nb: dict) -> bool:
+            a = annotations_of(nb)
+            if (nb_api.SUSPEND_ANNOTATION not in a
+                    or nb_api.SUSPEND_DRAINED_ANNOTATION in a):
+                return False
+            set_annotation(nb, nb_api.SUSPEND_DRAINED_ANNOTATION,
+                           now.isoformat())
+            return True
+
+        live = _update_retrying(api, notebook, mutate)
+        drained = annotations_of(live).get(
+            nb_api.SUSPEND_DRAINED_ANNOTATION)
+        if drained == now.isoformat():  # we won the stamp: observe once
+            since = _parse_ts(annotations_of(live).get(
+                nb_api.SUSPEND_ANNOTATION))
+            if since is not None:
+                metrics.SUSPEND_RESUME_SECONDS.labels(
+                    phase="drain").observe(
+                        max(0.0, (now - since).total_seconds()))
+            api.record_event(
+                live, "Normal", "Suspended",
+                f"slice drained; {nb_api.total_hosts(live)} host(s) of "
+                "chips returned to the pool")
+            kick_pending_pods(api, now=now.isoformat())
+        return None
+
+    # -- resume half -----------------------------------------------------
+    def _reconcile_resuming(self, api: APIServer, notebook: dict):
+        want = nb_api.total_hosts(notebook)
+        ready = deep_get(notebook, "status", "readyReplicas", default=0)
+        if ready < want:
+            # not re-ganged yet: pod/status events requeue us; the
+            # periodic tick below is only a backstop for lost events
+            return self.check_period.total_seconds()
+        ann = annotations_of(notebook)
+        token = None
+        raw = ann.get(nb_api.SUSPEND_CHECKPOINT_ANNOTATION)
+        if raw:
+            try:
+                token = json.loads(raw)
+            except ValueError:
+                token = None
+        t0 = time.perf_counter()
+        restored = self.store.restore(notebook, token)
+        restore_s = time.perf_counter() - t0
+        now = api.clock()
+        requested = _parse_ts(ann.get(nb_api.RESUME_REQUESTED_ANNOTATION))
+
+        def mutate(nb: dict) -> bool:
+            a = annotations_of(nb)
+            if nb_api.RESUME_REQUESTED_ANNOTATION not in a:
+                return False
+            a.pop(nb_api.RESUME_REQUESTED_ANNOTATION, None)
+            a.pop(nb_api.SUSPEND_CHECKPOINT_ANNOTATION, None)
+            a.pop(nb_api.SUSPEND_DRAINED_ANNOTATION, None)
+            a.pop(nb_api.SUSPEND_REASON_ANNOTATION, None)
+            if restored is not None and "step" in restored:
+                set_annotation(nb, nb_api.RESTORED_STEP_ANNOTATION,
+                               str(restored["step"]))
+            return True
+
+        live = _update_retrying(api, notebook, mutate)
+        if nb_api.RESUME_REQUESTED_ANNOTATION not in annotations_of(live):
+            metrics.SUSPEND_RESUME_SECONDS.labels(
+                phase="restore").observe(restore_s)
+            if requested is not None:
+                metrics.SUSPEND_RESUME_SECONDS.labels(
+                    phase="rebind").observe(
+                        max(0.0, (now - requested).total_seconds()))
+            metrics.NOTEBOOK_RESUME_TOTAL.inc()
+            api.record_event(
+                live, "Normal", "Resumed",
+                "slice re-ganged and state restored"
+                + (f" at step {restored['step']}"
+                   if restored and "step" in restored else ""))
+        return None
+
+    # -- idle initiation -------------------------------------------------
+    def _maybe_suspend_idle(self, api: APIServer, notebook: dict):
+        if self.suspend_idle is None or not oversubscribe():
+            return None
+        if nb_api.tpu_spec(notebook) is None:
+            return None  # CPU notebooks hold no chips worth reclaiming
+        ann = annotations_of(notebook)
+        if (nb_api.is_pinned(notebook)
+                or ann.get(nb_api.CULLING_EXCLUDE_ANNOTATION) == "true"):
+            return None
+        want = nb_api.total_hosts(notebook)
+        ready = deep_get(notebook, "status", "readyReplicas", default=0)
+        if ready < want:
+            return self.check_period.total_seconds()
+        now = api.clock()
+        idle_since = _parse_ts(ann.get(nb_api.LAST_ACTIVITY_ANNOTATION))
+        pod0 = api.try_get("Pod", f"{name_of(notebook)}-0",
+                           namespace_of(notebook))
+        started = _parse_ts(deep_get(
+            pod0, "status", "containerStatuses", 0, "state", "running",
+            "startedAt") if pod0 else None)
+        # a freshly (re)started slice restarts its idle clock — a
+        # resumed notebook gets a full idle window before re-parking
+        if started is not None and (idle_since is None
+                                    or started > idle_since):
+            idle_since = started
+        if idle_since is None:
+            idle_since = _parse_ts(
+                notebook["metadata"].get("creationTimestamp")) or now
+        if now - idle_since >= self.suspend_idle:
+            initiate_suspend(api, notebook, reason="idle",
+                             store=self.store)
+            return None
+        return self.check_period.total_seconds()
+
+
+# ---- preemptive gang-bind --------------------------------------------
+
+class _Victim:
+    __slots__ = ("notebook", "pods", "chips", "per_node", "priority",
+                 "idle_key")
+
+    def __init__(self, notebook, pods, priority, idle_key):
+        self.notebook = notebook
+        self.pods = pods
+        self.priority = priority
+        self.idle_key = idle_key
+        self.per_node: dict[str, float] = {}
+        self.chips = 0.0
+        for p in pods:
+            node = deep_get(p, "spec", "nodeName")
+            c = scheduler._pod_chips(p)
+            if node and c:
+                self.per_node[node] = self.per_node.get(node, 0.0) + c
+                self.chips += c
+
+
+def try_preempt(api: APIServer, sts: dict, unbound: list[dict],
+                sched: "scheduler.SchedulerCache", *,
+                allow_virtual: bool) -> dict[tuple, str] | None:
+    """A gang that couldn't bind gets one more chance: suspend strictly
+    lower-priority victim slices (never pinned ones) through the normal
+    suspend lifecycle, delete their pods (kube-scheduler's preemption
+    semantics — the victim's controller converges on replicas=0 from
+    the suspend annotation), and retry the gang-bind. Victim choice is
+    simulated first so an insufficient pool suspends nobody; selection
+    order is (priority asc, idleness desc, fragmentation fit). Returns
+    a bind plan like ``gang_bind`` or None."""
+    if not oversubscribe() or scheduler.legacy_scan():
+        return None
+    nb_name = (sts["metadata"].get("labels") or {}).get(
+        nb_api.NOTEBOOK_NAME_LABEL)
+    if not nb_name:
+        return None  # not a notebook slice: no priority to preempt with
+    ns = namespace_of(sts)
+    incoming = api.try_get(nb_api.KIND, nb_name, ns)
+    if incoming is None:
+        return None
+    incoming_pri = nb_api.priority_of(incoming)
+    needed = sum(scheduler._pod_chips(p) for p in unbound)
+    if not needed:
+        return None
+
+    victims = _candidate_victims(api, incoming, incoming_pri, needed)
+    if not victims:
+        return None
+
+    by_node = sched.free_by_node()
+    free = {node: f for node, (f, _labels) in by_node.items()}
+    labels = {node: lb for node, (_f, lb) in by_node.items()}
+    chosen: list[_Victim] = []
+    for v in victims:
+        chosen.append(v)
+        extra: dict[str, float] = {}
+        for c in chosen:
+            for node, chips in c.per_node.items():
+                extra[node] = extra.get(node, 0.0) + chips
+        if _fits(unbound, free, extra, labels, allow_virtual):
+            break
+    else:
+        return None  # even suspending every candidate wouldn't fit
+
+    for v in chosen:
+        initiate_suspend(api, v.notebook, reason="preempted")
+        # scale the victim's StatefulSet down ourselves before deleting
+        # its pods — its kubelet reconcile must not race a recreate in
+        # the window before the notebook controller re-renders
+        v_sts = api.try_get("StatefulSet", name_of(v.notebook),
+                            namespace_of(v.notebook))
+        if v_sts is not None and deep_get(
+                v_sts, "spec", "replicas", default=0):
+            for _ in range(4):
+                v_sts["spec"]["replicas"] = 0
+                try:
+                    api.update(v_sts)
+                    break
+                except Conflict:
+                    v_sts = api.try_get(
+                        "StatefulSet", name_of(v.notebook),
+                        namespace_of(v.notebook))
+                    if v_sts is None:
+                        break
+        for p in v.pods:
+            key = (namespace_of(p), name_of(p))
+            try:
+                api.delete("Pod", key[1], key[0])
+            except NotFound:
+                pass
+            sched.release(key)
+        metrics.NOTEBOOK_PREEMPT_TOTAL.inc()
+    api.record_event(
+        sts, "Normal", "Preempted",
+        f"suspended {len(chosen)} lower-priority slice(s) "
+        f"({', '.join(name_of(v.notebook) for v in chosen)}) to admit "
+        f"this {len(unbound)}-host gang")
+    return sched.gang_bind(unbound, allow_virtual=allow_virtual)
+
+
+def _candidate_victims(api: APIServer, incoming: dict,
+                       incoming_pri: int, needed: float) -> list:
+    scan = getattr(api, "scan", api.list)
+    out: list[_Victim] = []
+    in_key = (namespace_of(incoming), name_of(incoming))
+    for nb in scan(nb_api.KIND):
+        if (namespace_of(nb), name_of(nb)) == in_key:
+            continue
+        if nb["metadata"].get("deletionTimestamp"):
+            continue
+        ann = annotations_of(nb)
+        if (nb_api.SUSPEND_ANNOTATION in ann
+                or nb_api.STOP_ANNOTATION in ann
+                or nb_api.RESUME_REQUESTED_ANNOTATION in ann):
+            continue
+        if nb_api.is_pinned(nb):
+            continue
+        pri = nb_api.priority_of(nb)
+        if pri >= incoming_pri:
+            continue  # preemption displaces strictly lower priority only
+        name, ns2 = name_of(nb), namespace_of(nb)
+        pods = [p for p in scan("Pod", ns2)
+                if (p["metadata"].get("labels") or {}).get(
+                    nb_api.NOTEBOOK_NAME_LABEL) == name
+                and deep_get(p, "spec", "nodeName")
+                and deep_get(p, "status", "phase")
+                not in scheduler.TERMINAL_PHASES]
+        v = _Victim(nb, pods, pri,
+                    ann.get(nb_api.LAST_ACTIVITY_ANNOTATION)
+                    or nb["metadata"].get("creationTimestamp") or "")
+        if not v.chips:
+            continue
+        out.append(v)
+    # lowest priority, then longest idle (oldest activity stamp), then
+    # the fragmentation fit: smallest sufficient slice first so a big
+    # victim isn't shattered to seat a small gang
+    out.sort(key=lambda v: (
+        v.priority, v.idle_key,
+        (v.chips < needed, abs(v.chips - needed))))
+    return out
+
+
+def _fits(unbound: list[dict], free: dict[str, float],
+          extra: dict[str, float], labels: dict[str, dict],
+          allow_virtual: bool) -> bool:
+    """Dry-run of the gang first-fit against free+released capacity —
+    mirrors ``SchedulerCache._try_gang`` selection without locks."""
+    from kubeflow_rm_tpu.controlplane.api.meta import matches_selector
+    tentative: dict[str, float] = {}
+    for pod in sorted(unbound, key=name_of):
+        selector = deep_get(pod, "spec", "nodeSelector", default={}) or {}
+        need = scheduler._pod_chips(pod)
+        chosen = None
+        for node, f in free.items():
+            if selector and not matches_selector(
+                    labels.get(node, {}), {"matchLabels": selector}):
+                continue
+            if need:
+                avail = f + extra.get(node, 0.0) - tentative.get(node, 0.0)
+                if need > avail:
+                    continue
+            chosen = node
+            break
+        if chosen is None:
+            if allow_virtual and not selector and not need:
+                continue
+            return False
+        if need:
+            tentative[chosen] = tentative.get(chosen, 0.0) + need
+    return True
+
+
+def _parse_ts(raw) -> datetime.datetime | None:
+    if not raw:
+        return None
+    try:
+        return datetime.datetime.fromisoformat(
+            str(raw).replace("Z", "+00:00"))
+    except ValueError:
+        return None

@@ -239,7 +239,15 @@ class WebApp:
         if user is not None:
             headers.append((self.user_header, self.user_prefix + user))
         token = secrets.token_urlsafe(16)
-        client.set_cookie(CSRF_COOKIE, token, path=self.prefix or "/")
+        import inspect
+        params = list(inspect.signature(client.set_cookie).parameters)
+        if params and params[0] == "server_name":
+            # werkzeug < 2.3 leads with the cookie domain
+            client.set_cookie("localhost", CSRF_COOKIE, token,
+                              path=self.prefix or "/")
+        else:
+            client.set_cookie(CSRF_COOKIE, token,
+                              path=self.prefix or "/")
         headers.append((CSRF_HEADER, token))
         return _ClientProxy(client, headers)
 

@@ -33,6 +33,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     annotations_of, deep_get, fast_deepcopy, set_annotation,
 )
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane import suspend as suspend_mod
 from kubeflow_rm_tpu.controlplane.webapps import status as status_mod
 from kubeflow_rm_tpu.controlplane.webapps.core import WebApp, json_body
 from kubeflow_rm_tpu.controlplane.webapps.readiness import ReadinessHub
@@ -373,6 +374,16 @@ def create_app(api: APIServer, *, config_path: str | None = None,
         timeout = max(0.0, min(timeout, 120.0))
         known = req.args.get("knownVersion", "")
 
+        # an incoming readiness poll IS demand: transparently resume a
+        # suspended notebook before blocking (wake=false opts out for
+        # passive dashboards that must not un-park what they observe)
+        if req.args.get("wake", "true") != "false":
+            cur = api.try_get(nb_api.KIND, name, namespace)
+            if cur is not None and \
+                    nb_api.SUSPEND_ANNOTATION in annotations_of(cur):
+                suspend_mod.request_resume(api, cur,
+                                           source="readiness request")
+
         def fetch():
             return api.try_get(nb_api.KIND, name, namespace)
 
@@ -476,6 +487,10 @@ def create_app(api: APIServer, *, config_path: str | None = None,
         set_configurations(nb, body, defaults)
         set_shm(nb, body, defaults)
         set_environment(nb, body, defaults)
+        cls = get_form_value(body, defaults, "priorityClassName",
+                             optional=True)
+        if cls:
+            nb["spec"]["priorityClassName"] = cls
 
         vols = list(get_form_value(body, defaults, "datavols", "dataVolumes")
                     or [])
@@ -513,6 +528,14 @@ def create_app(api: APIServer, *, config_path: str | None = None,
             else:
                 ann.pop(nb_api.STOP_ANNOTATION, None)
             api.update(nb)
+        if "suspended" in body:
+            # the API arm of the lifecycle: true parks the slice
+            # through the same checkpoint-then-drain path the idle
+            # suspender uses; false is an explicit resume request
+            if body["suspended"]:
+                suspend_mod.initiate_suspend(api, nb, reason="api")
+            else:
+                suspend_mod.request_resume(api, nb, source="api")
         return {"message": "Notebook updated successfully."}
 
     @app.route("/api/namespaces/<namespace>/notebooks/<name>",

@@ -41,6 +41,10 @@ def _controlplane_section(api=None) -> dict:
     controller-manager lease (from the store) plus the in-process
     workqueue/leadership gauges (``controlplane/metrics.py``)."""
     from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+    from kubeflow_rm_tpu.controlplane import scheduler as cp_scheduler
+    # free/fragmentation gauges are computed on stats() — bring them
+    # current so the pills reflect the live cache, not the last bind
+    cp_scheduler.refresh_gauges()
     leader, transitions = None, None
     if api is not None:
         try:
@@ -147,6 +151,35 @@ def _controlplane_section(api=None) -> dict:
                 "scheduler_cache_events_total"),
             "cache_rebuilds": cp_metrics.registry_value(
                 "scheduler_cache_rebuilds_total"),
+            # bin-packing health: stranded = free - largest_free_gang
+            # (chips no single gang can use at the current spread)
+            "free_chips": cp_metrics.registry_value(
+                "scheduler_free_chips"),
+            "largest_free_gang": cp_metrics.registry_value(
+                "scheduler_largest_free_gang_chips"),
+            "fragmentation": cp_metrics.registry_value(
+                "scheduler_fragmentation"),
+        },
+        # oversubscription lifecycle: suspensions by reason, resumes
+        # with state restored, preemption victims, per-phase latency
+        "suspend": {
+            "suspended": cp_metrics.registry_value(
+                "notebook_suspend_total"),
+            "resumed": cp_metrics.registry_value(
+                "notebook_resume_total"),
+            "preempted": cp_metrics.registry_value(
+                "notebook_preempt_total"),
+            "phase_seconds": {
+                p: {
+                    "count": cp_metrics.registry_value(
+                        "suspend_resume_phase_seconds_count",
+                        {"phase": p}),
+                    "seconds": cp_metrics.registry_value(
+                        "suspend_resume_phase_seconds_sum",
+                        {"phase": p}),
+                }
+                for p in ("drain", "rebind", "restore")
+            },
         },
         # push readiness: long-polls currently parked on the hub and
         # the event-arrival -> waiter-observation latency that replaced
@@ -316,6 +349,22 @@ class PrometheusMetricsService:
                         "scheduler_cache_events_total"),
                     "cache_rebuilds": g.get(
                         "scheduler_cache_rebuilds_total"),
+                    "free_chips": g.get("scheduler_free_chips"),
+                    "largest_free_gang": g.get(
+                        "scheduler_largest_free_gang_chips"),
+                    "fragmentation": g.get("scheduler_fragmentation"),
+                },
+                # reason/phase labels summed by the flat scrape
+                "suspend": {
+                    "suspended": g.get("notebook_suspend_total"),
+                    "resumed": g.get("notebook_resume_total"),
+                    "preempted": g.get("notebook_preempt_total"),
+                    "phase_seconds": {
+                        "count": g.get(
+                            "suspend_resume_phase_seconds_count"),
+                        "seconds": g.get(
+                            "suspend_resume_phase_seconds_sum"),
+                    },
                 },
                 "readiness": {
                     "waiters": g.get("readiness_waiters"),

@@ -23,6 +23,7 @@ PHASE_WAITING = "waiting"
 PHASE_WARNING = "warning"
 PHASE_TERMINATING = "terminating"
 PHASE_STOPPED = "stopped"
+PHASE_SUSPENDED = "suspended"
 
 
 @dataclass(frozen=True)
@@ -39,6 +40,17 @@ def process_status(notebook: dict, events: list[dict] | None = None) -> Status:
 
     if notebook["metadata"].get("deletionTimestamp"):
         return Status(PHASE_TERMINATING, "Deleting this Notebook.")
+
+    if nb_api.SUSPEND_ANNOTATION in ann:
+        # suspended ≠ stopped: the chips went back to the pool, but any
+        # incoming request (this UI included) transparently resumes it
+        if deep_get(notebook, "status", "readyReplicas", default=0):
+            return Status(PHASE_WAITING, "Suspending this Notebook.")
+        return Status(PHASE_SUSPENDED,
+                      "Notebook is suspended; its TPU slice was released. "
+                      "It will resume automatically on the next request.")
+    if nb_api.RESUME_REQUESTED_ANNOTATION in ann:
+        return Status(PHASE_WAITING, "Resuming this Notebook.")
 
     if nb_api.STOP_ANNOTATION in ann:
         # mirrors get_stopped_status: a stopped CR with replicas still

@@ -1,20 +1,39 @@
-from kubeflow_rm_tpu.training.checkpoint import Checkpointer, abstract_state
-from kubeflow_rm_tpu.training.loop import LoopConfig, LoopMetrics, fit
-from kubeflow_rm_tpu.training.train import (
-    TrainConfig,
-    TrainState,
-    init_train_state,
-    make_train_step,
-)
+"""Training package: lazy exports (PEP 562).
 
-__all__ = [
-    "Checkpointer",
-    "LoopConfig",
-    "LoopMetrics",
-    "TrainConfig",
-    "TrainState",
-    "abstract_state",
-    "fit",
-    "init_train_state",
-    "make_train_step",
-]
+``kubeflow_rm_tpu.training.checkpoint`` must be importable on a plain
+CPU host with only jax+orbax (the control plane's Checkpointer-backed
+suspend state store and its tests live there); eagerly importing the
+model/parallelism stack here would drag the whole compute dependency
+chain into every control-plane process.
+"""
+
+_EXPORTS = {
+    "Checkpointer": ("kubeflow_rm_tpu.training.checkpoint", "Checkpointer"),
+    "abstract_state": ("kubeflow_rm_tpu.training.checkpoint",
+                       "abstract_state"),
+    "LoopConfig": ("kubeflow_rm_tpu.training.loop", "LoopConfig"),
+    "LoopMetrics": ("kubeflow_rm_tpu.training.loop", "LoopMetrics"),
+    "fit": ("kubeflow_rm_tpu.training.loop", "fit"),
+    "TrainConfig": ("kubeflow_rm_tpu.training.train", "TrainConfig"),
+    "TrainState": ("kubeflow_rm_tpu.training.train", "TrainState"),
+    "init_train_state": ("kubeflow_rm_tpu.training.train",
+                         "init_train_state"),
+    "make_train_step": ("kubeflow_rm_tpu.training.train",
+                        "make_train_step"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(module), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

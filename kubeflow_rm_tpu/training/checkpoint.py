@@ -18,10 +18,6 @@ from typing import Any
 
 import jax
 
-from kubeflow_rm_tpu.training.train import (
-    TrainConfig, TrainState, init_train_state, state_shardings,
-)
-
 
 def _ocp():
     # lazy: bench.py and the train step must not require orbax — an
@@ -30,9 +26,15 @@ def _ocp():
     return ocp
 
 
-def abstract_state(cfg: TrainConfig, mesh) -> Any:
+def abstract_state(cfg, mesh) -> Any:
     """TrainState of ShapeDtypeStructs carrying NamedShardings — the
     restore target layout, computed without allocating anything."""
+    # lazy: this module must import on a plain CPU control-plane host
+    # (the suspend state store uses latest_step/save/restore on dict
+    # pytrees); only model-state restores pull in the train stack
+    from kubeflow_rm_tpu.training.train import (
+        init_train_state, state_shardings,
+    )
     shapes = jax.eval_shape(
         lambda: init_train_state(cfg, jax.random.key(0)))
     shardings = state_shardings(cfg, shapes, mesh)
@@ -69,21 +71,30 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
-    def save(self, state: TrainState, *, force: bool = False) -> bool:
-        step = int(jax.device_get(state.step))
+    def save(self, state, *, force: bool = False) -> bool:
+        # state is a TrainState or any pytree with a "step" leaf — the
+        # suspend state store checkpoints plain dicts
+        raw = state["step"] if isinstance(state, dict) else state.step
+        step = int(jax.device_get(raw))
         if step in self._mngr.all_steps():
             return False
         return self._mngr.save(step, args=_ocp().args.StandardSave(state),
                                force=force)
 
-    def restore(self, cfg: TrainConfig, mesh,
-                step: int | None = None) -> TrainState | None:
-        """Restore the latest (or given) step into mesh shardings, or
-        None when the directory holds no checkpoint yet."""
+    def restore(self, cfg=None, mesh=None,
+                step: int | None = None) -> Any | None:
+        """Restore the latest (or given) step, or None when the
+        directory holds no checkpoint yet. With ``cfg``/``mesh`` the
+        target is the TrainState layout on that mesh (each host reads
+        its shards); without them orbax restores the saved tree as-is
+        (the dict-pytree path the suspend state store uses)."""
         if step is None:
             step = self._mngr.latest_step()
         if step is None:
             return None
+        if cfg is None:
+            return self._mngr.restore(
+                step, args=_ocp().args.StandardRestore())
         target = abstract_state(cfg, mesh)
         return self._mngr.restore(
             step, args=_ocp().args.StandardRestore(target))

@@ -205,3 +205,37 @@ def test_unreachable_probe_emits_warning_event(stack):
     evs = [e for e in api.events_for(nb)
            if e["reason"] == "CullingProbeFailed"]
     assert len(evs) == 1
+
+def test_pin_annotation_prevents_culling(stack):
+    """tpu.kubeflow.org/do-not-suspend pins the slice for the
+    notebook's lifetime: the culler must skip it no matter how idle
+    (the same annotation also exempts it from idle suspension and
+    preemption — see test_suspend.py)."""
+    api, mgr, clock, jupyter = stack
+    jupyter.activity()
+    nb = make_notebook(
+        "pinned", "u", accelerator_type="v5p-16",
+        annotations={nb_api.PIN_ANNOTATION: "true"})
+    api.create(nb)
+    mgr.run_until_idle()
+    clock.advance(minutes=600)
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "pinned", "u")
+    assert nb_api.STOP_ANNOTATION not in annotations_of(nb)
+    assert len(api.list("Pod", "u")) == 2
+
+
+def test_pin_annotation_false_value_still_culls(stack):
+    """An explicit \"false\" is not a pin — presence alone doesn't
+    protect (mirrors the stop annotation's string semantics)."""
+    api, mgr, clock, jupyter = stack
+    jupyter.activity()
+    nb = make_notebook(
+        "unpinned", "u", accelerator_type="v5p-16",
+        annotations={nb_api.PIN_ANNOTATION: "false"})
+    api.create(nb)
+    mgr.run_until_idle()
+    clock.advance(minutes=600)
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "unpinned", "u")
+    assert nb_api.STOP_ANNOTATION in annotations_of(nb)

@@ -331,3 +331,74 @@ def test_statefulset_status_excludes_terminal_pods_from_gauge():
     mgr.enqueue_all()
     mgr.run_until_idle()
     assert metrics.registry_value("tpu_chips_requested") == 0.0
+
+
+# ---- release + fragmentation stats (oversubscription round) ----------
+
+def test_release_frees_capacity_out_of_band():
+    """release() is the suspend/preemption teardown hook: confirmed or
+    assumed, the entry's chips return synchronously — no waiting on
+    the delete event to ride the watch fanout."""
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    pod = api.create(_pod("p0", 8))
+    plan = cache.gang_bind([pod], allow_virtual=False)
+    assert plan == {("d", "p0"): "n0"}
+    cache.confirm(("d", "p0"), 5)
+    assert cache.node_used("n0") == 8.0
+
+    cache.release(("d", "p0"))
+    assert cache.node_used("n0") == 0.0
+    # a second gang binds immediately against the freed chips
+    p1 = api.create(_pod("p1", 8))
+    assert cache.gang_bind([p1], allow_virtual=False) is not None
+    # releasing an unknown key is a no-op, not an error
+    cache.release(("d", "missing"))
+
+
+def test_release_of_assumed_entry_decrements_assumed_gauge():
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+    pod = api.create(_pod("p0", 4))
+    cache.gang_bind([pod], allow_virtual=False)
+    assert cache.stats()["assumed"] == 1
+    cache.release(("d", "p0"))
+    assert cache.stats()["assumed"] == 0
+
+
+def test_stats_fragmentation_gauge():
+    """largest_free_gang maximizes gang chips over identical hosts:
+    free [6, 2] can seat one 6-chip host or a 2x2 gang — 6 wins; the
+    stranded remainder is the fragmentation signal."""
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))
+    api.create(_node("n1", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    s = cache.stats()
+    assert s["free_chips"] == 16.0
+    assert s["largest_free_gang"] == 16.0  # 2 hosts x 8 chips
+    assert s["fragmentation"] == 0.0
+
+    cache.observe("ADDED", api.create(_pod("a", 2, node="n0")))
+    cache.observe("ADDED", api.create(_pod("b", 6, node="n1")))
+    s = cache.stats()
+    assert s["free_chips"] == 8.0          # free per node: [6, 2]
+    assert s["largest_free_gang"] == 6.0   # one 6-chip host beats 2x2
+    assert s["fragmentation"] == pytest.approx(1 - 6 / 8)
+
+    # full fleet: fragmentation pins to 0, not NaN
+    cache.observe("ADDED", api.create(_pod("c", 6, node="n0")))
+    cache.observe("ADDED", api.create(_pod("d2", 2, node="n1")))
+    s = cache.stats()
+    assert s["free_chips"] == 0.0
+    assert s["fragmentation"] == 0.0

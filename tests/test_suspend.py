@@ -1,0 +1,367 @@
+"""Suspend/resume lifecycle + priority-preemptive gang scheduling
+(controlplane/suspend.py): idle slices checkpoint and release their
+chips, any incoming request resumes them, and a higher-priority gang
+that cannot fit suspends lower-priority victims all-or-nothing."""
+
+import json
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import (
+    make_control_plane, metrics, scheduler, suspend,
+)
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import (
+    annotations_of, set_annotation,
+)
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+    make_tpu_node,
+)
+from kubeflow_rm_tpu.controlplane.webapps import status as status_mod
+from kubeflow_rm_tpu.controlplane.webapps.jupyter import create_app
+from tests.cp_fixtures import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store():
+    suspend.set_state_store(suspend.InMemoryStateStore())
+    suspend.set_oversubscribe(True)
+    yield
+    suspend.set_oversubscribe(True)
+
+
+@pytest.fixture
+def stack():
+    """Two v5p-16 nodes = capacity for exactly one 2-host slice's
+    worth of notebooks at a time (each v5p-16 slice takes both)."""
+    clock = FakeClock()
+    api, mgr = make_control_plane(
+        clock=clock, enable_suspend=True,
+        suspend_config={"suspend_idle_minutes": 30.0,
+                        "check_period_minutes": 1.0})
+    api.ensure_namespace("u")
+    for i in range(2):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    return api, mgr, clock
+
+
+def _ready(api, name, ns="u"):
+    return (api.get(nb_api.KIND, name, ns).get("status") or {}).get(
+        "readyReplicas", 0)
+
+
+# ---- idle suspension -------------------------------------------------
+
+def test_idle_notebook_suspends_and_releases_chips(stack):
+    api, mgr, clock = stack
+    nb = make_notebook("idle", "u", accelerator_type="v5p-16")
+    set_annotation(nb, nb_api.TRAINING_STEP_ANNOTATION, "7")
+    api.create(nb)
+    mgr.run_until_idle()
+    assert len(api.list("Pod", "u")) == 2
+
+    clock.advance(minutes=31)
+    mgr.run_until_idle()
+
+    nb = api.get(nb_api.KIND, "idle", "u")
+    ann = annotations_of(nb)
+    assert nb_api.SUSPEND_ANNOTATION in ann
+    assert ann[nb_api.SUSPEND_REASON_ANNOTATION] == "idle"
+    assert nb_api.SUSPEND_DRAINED_ANNOTATION in ann
+    # the checkpoint token recorded the workload's durable step
+    assert json.loads(ann[nb_api.SUSPEND_CHECKPOINT_ANNOTATION]) == {
+        "step": 7}
+    # whole slice drained, chips back in the pool
+    assert api.list("Pod", "u") == []
+    assert api.get("StatefulSet", "idle", "u")["spec"]["replicas"] == 0
+    assert nb["status"]["phase"] == nb_api.SUSPENDED_PHASE
+    stats = scheduler.cache_for(api).stats()
+    # both v5p-16 hosts (4 chips each) back in the pool
+    assert stats["free_chips"] == 8.0
+    assert stats["largest_free_gang"] == 8.0
+    assert stats["fragmentation"] == 0.0
+
+
+def test_resume_restores_checkpointed_step(stack):
+    api, mgr, clock = stack
+    nb = make_notebook("nb", "u", accelerator_type="v5p-16")
+    set_annotation(nb, nb_api.TRAINING_STEP_ANNOTATION, "42")
+    api.create(nb)
+    mgr.run_until_idle()
+    clock.advance(minutes=31)
+    mgr.run_until_idle()
+    assert api.list("Pod", "u") == []
+
+    suspend.request_resume(api, api.get(nb_api.KIND, "nb", "u"))
+    mgr.run_until_idle()
+
+    nb = api.get(nb_api.KIND, "nb", "u")
+    ann = annotations_of(nb)
+    assert _ready(api, "nb") == 2
+    # restored exactly at the pre-suspend checkpoint step
+    assert ann[nb_api.RESTORED_STEP_ANNOTATION] == "42"
+    # cycle annotations cleared — ready for the next suspend
+    assert nb_api.SUSPEND_ANNOTATION not in ann
+    assert nb_api.RESUME_REQUESTED_ANNOTATION not in ann
+    assert nb_api.SUSPEND_CHECKPOINT_ANNOTATION not in ann
+    assert any(e["reason"] == "Resumed" for e in api.events_for(nb))
+
+
+def test_pinned_notebook_never_idle_suspended(stack):
+    api, mgr, clock = stack
+    nb = make_notebook("pinned", "u", accelerator_type="v5p-16",
+                       annotations={nb_api.PIN_ANNOTATION: "true"})
+    api.create(nb)
+    mgr.run_until_idle()
+    clock.advance(minutes=120)
+    mgr.run_until_idle()
+    ann = annotations_of(api.get(nb_api.KIND, "pinned", "u"))
+    assert nb_api.SUSPEND_ANNOTATION not in ann
+    assert len(api.list("Pod", "u")) == 2
+
+
+def test_no_oversubscribe_arm_disables_idle_suspension(stack):
+    api, mgr, clock = stack
+    suspend.set_oversubscribe(False)
+    api.create(make_notebook("nb", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    clock.advance(minutes=120)
+    mgr.run_until_idle()
+    ann = annotations_of(api.get(nb_api.KIND, "nb", "u"))
+    assert nb_api.SUSPEND_ANNOTATION not in ann
+    assert len(api.list("Pod", "u")) == 2
+
+
+def test_resumed_notebook_gets_fresh_idle_window(stack):
+    api, mgr, clock = stack
+    api.create(make_notebook("nb", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    clock.advance(minutes=31)
+    mgr.run_until_idle()
+    assert nb_api.SUSPEND_ANNOTATION in annotations_of(
+        api.get(nb_api.KIND, "nb", "u"))
+
+    suspend.request_resume(api, api.get(nb_api.KIND, "nb", "u"))
+    mgr.run_until_idle()
+    assert _ready(api, "nb") == 2
+    # 20 more minutes < 30: the idle clock restarted at resume
+    clock.advance(minutes=20)
+    mgr.run_until_idle()
+    assert nb_api.SUSPEND_ANNOTATION not in annotations_of(
+        api.get(nb_api.KIND, "nb", "u"))
+    assert _ready(api, "nb") == 2
+
+
+# ---- preemption ------------------------------------------------------
+
+def test_higher_priority_gang_displaces_one_victim(stack):
+    api, mgr, _clock = stack
+    api.create(make_notebook("low", "u", accelerator_type="v5p-16",
+                             priority_class="low"))
+    mgr.run_until_idle()
+    assert _ready(api, "low") == 2
+
+    api.create(make_notebook("high", "u", accelerator_type="v5p-16",
+                             priority_class="high"))
+    mgr.run_until_idle()
+
+    low = api.get(nb_api.KIND, "low", "u")
+    ann = annotations_of(low)
+    assert ann.get(nb_api.SUSPEND_REASON_ANNOTATION) == "preempted"
+    assert nb_api.SUSPEND_DRAINED_ANNOTATION in ann
+    # the newcomer bound all-or-nothing; exactly one victim suspended
+    assert _ready(api, "high") == 2
+    names = {p["metadata"]["name"] for p in api.list("Pod", "u")}
+    assert names == {"high-0", "high-1"}
+    high_sts = api.get("StatefulSet", "high", "u")
+    assert any(e["reason"] == "Preempted"
+               for e in api.events_for(high_sts))
+
+
+def test_pinned_victim_never_selected(stack):
+    api, mgr, _clock = stack
+    api.create(make_notebook(
+        "pinned-low", "u", accelerator_type="v5p-16",
+        priority_class="low",
+        annotations={nb_api.PIN_ANNOTATION: "true"}))
+    mgr.run_until_idle()
+    api.create(make_notebook("high", "u", accelerator_type="v5p-16",
+                             priority_class="high"))
+    mgr.run_until_idle()
+
+    # the pinned slice kept its chips; the high gang waits
+    assert _ready(api, "pinned-low") == 2
+    assert _ready(api, "high") == 0
+    ann = annotations_of(api.get(nb_api.KIND, "pinned-low", "u"))
+    assert nb_api.SUSPEND_ANNOTATION not in ann
+
+
+def test_equal_priority_never_preempts(stack):
+    api, mgr, _clock = stack
+    api.create(make_notebook("first", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    api.create(make_notebook("second", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    # default vs default: first-come-first-served preserved
+    assert _ready(api, "first") == 2
+    assert _ready(api, "second") == 0
+
+
+def test_no_oversubscribe_arm_disables_preemption(stack):
+    api, mgr, _clock = stack
+    suspend.set_oversubscribe(False)
+    api.create(make_notebook("low", "u", accelerator_type="v5p-16",
+                             priority_class="low"))
+    mgr.run_until_idle()
+    api.create(make_notebook("high", "u", accelerator_type="v5p-16",
+                             priority_class="high"))
+    mgr.run_until_idle()
+    assert _ready(api, "low") == 2
+    assert _ready(api, "high") == 0
+
+
+def test_preempted_victim_regangs_when_capacity_frees(stack):
+    api, mgr, _clock = stack
+    api.create(make_notebook("low", "u", accelerator_type="v5p-16",
+                             priority_class="low"))
+    mgr.run_until_idle()
+    api.create(make_notebook("high", "u", accelerator_type="v5p-16",
+                             priority_class="high"))
+    mgr.run_until_idle()
+    assert _ready(api, "high") == 2
+
+    # victim expresses demand while the fleet is full: stays parked
+    suspend.request_resume(api, api.get(nb_api.KIND, "low", "u"))
+    mgr.run_until_idle()
+    assert _ready(api, "low") == 0
+    assert _ready(api, "high") == 2  # a lower priority never preempts
+
+    # the high slice suspends -> freed chips flow to the waiter
+    suspend.initiate_suspend(
+        api, api.get(nb_api.KIND, "high", "u"), reason="api")
+    mgr.run_until_idle()
+    assert _ready(api, "low") == 2
+    assert api.get(nb_api.KIND, "high", "u")["status"]["phase"] == \
+        nb_api.SUSPENDED_PHASE
+
+
+# ---- priority API ----------------------------------------------------
+
+def test_priority_resolution_and_validation():
+    nb = make_notebook("a", "u", priority_class="high")
+    assert nb_api.priority_of(nb) == nb_api.PRIORITY_CLASSES["high"]
+    nb["spec"]["priority"] = 5
+    assert nb_api.priority_of(nb) == 5  # explicit integer wins
+    assert nb_api.priority_of(make_notebook("b", "u")) == \
+        nb_api.DEFAULT_PRIORITY
+    with pytest.raises(ValueError):
+        nb_api.validate(make_notebook("c", "u",
+                                      priority_class="platinum"))
+    bad = make_notebook("d", "u")
+    bad["spec"]["priority"] = "urgent"
+    with pytest.raises(ValueError):
+        nb_api.validate(bad)
+
+
+# ---- web app surface -------------------------------------------------
+
+def test_patch_suspended_and_status_ladder(stack):
+    api, mgr, _clock = stack
+    api.create(make_notebook("nb", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    app = create_app(api, disable_auth=True)
+    client = app.test_client()
+
+    r = client.patch("/api/namespaces/u/notebooks/nb",
+                     data=json.dumps({"suspended": True}),
+                     headers=[("Content-Type", "application/json")])
+    assert r.status_code == 200
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "nb", "u")
+    st = status_mod.process_status(nb, api.events_for(nb))
+    assert st.phase == status_mod.PHASE_SUSPENDED
+
+    r = client.patch("/api/namespaces/u/notebooks/nb",
+                     data=json.dumps({"suspended": False}),
+                     headers=[("Content-Type", "application/json")])
+    assert r.status_code == 200
+    mgr.run_until_idle()
+    assert _ready(api, "nb") == 2
+
+
+def test_readiness_longpoll_auto_resumes(stack):
+    api, mgr, _clock = stack
+    api.create(make_notebook("nb", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    suspend.initiate_suspend(
+        api, api.get(nb_api.KIND, "nb", "u"), reason="api")
+    mgr.run_until_idle()
+    assert api.list("Pod", "u") == []
+
+    app = create_app(api, disable_auth=True)
+    client = app.test_client()
+    # the long-poll itself is the demand signal: it flips the notebook
+    # back toward Running before blocking (timeoutSeconds=0 returns
+    # immediately; the controllers run after)
+    client.get("/api/namespaces/u/notebooks/nb/readiness?timeoutSeconds=0")
+    mgr.run_until_idle()
+    assert _ready(api, "nb") == 2
+
+    # wake=false observes without resuming
+    suspend.initiate_suspend(
+        api, api.get(nb_api.KIND, "nb", "u"), reason="api")
+    mgr.run_until_idle()
+    client.get("/api/namespaces/u/notebooks/nb/readiness"
+               "?timeoutSeconds=0&wake=false")
+    mgr.run_until_idle()
+    assert nb_api.SUSPEND_ANNOTATION in annotations_of(
+        api.get(nb_api.KIND, "nb", "u"))
+
+
+# ---- state stores ----------------------------------------------------
+
+def test_checkpointer_state_store_bridges_latest_step():
+    class FakeManager:
+        def __init__(self):
+            self.step = 1234
+            self.waited = False
+
+        def wait(self):
+            self.waited = True
+
+        def latest_step(self):
+            return self.step
+
+    mgr = FakeManager()
+    store = suspend.CheckpointerStateStore(lambda ns, name: mgr)
+    nb = make_notebook("nb", "u")
+    token = store.snapshot(nb)
+    assert token == {"step": 1234}
+    assert mgr.waited  # pending async saves flushed before teardown
+    assert store.restore(nb, token) == {"step": 1234}
+    # a regressed checkpoint reports the degradation
+    mgr.step = 1000
+    out = store.restore(nb, token)
+    assert out["step"] == 1000 and out["degraded_from"] == 1234
+
+
+def test_suspend_metrics_observed(stack):
+    api, mgr, clock = stack
+    before = metrics.registry_value("notebook_suspend_total",
+                                    {"reason": "idle"})
+    api.create(make_notebook("nb", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    clock.advance(minutes=31)
+    mgr.run_until_idle()
+    assert metrics.registry_value(
+        "notebook_suspend_total", {"reason": "idle"}) == before + 1
+    drains = metrics.registry_value(
+        "suspend_resume_phase_seconds_count", {"phase": "drain"})
+    assert drains >= 1
+    suspend.request_resume(api, api.get(nb_api.KIND, "nb", "u"))
+    mgr.run_until_idle()
+    assert metrics.registry_value(
+        "suspend_resume_phase_seconds_count", {"phase": "rebind"}) >= 1
+    assert metrics.registry_value(
+        "suspend_resume_phase_seconds_count", {"phase": "restore"}) >= 1

@@ -61,8 +61,9 @@ def wallclock_main(args) -> int:
     runs = []
     throttled = {"calls": 0, "seconds": 0.0}
     readiness = {"status_gets": 0, "readiness_gets": 0}
+    once = _wallclock_once_sharded if args.shards > 1 else _wallclock_once
     for r in range(max(1, args.runs)):
-        res = _wallclock_once(args, phases)
+        res = once(args, phases)
         tr = res.pop("_throttle", None)
         if tr:
             throttled["calls"] += tr["calls"]
@@ -79,6 +80,8 @@ def wallclock_main(args) -> int:
     p95s = sorted(r["provision_p95_ms"] for r in runs)
     result = {
         "mode": "wallclock",
+        "shards": args.shards,
+        "wal": args.shards > 1 and not args.no_wal,
         "cache": "off" if args.no_cache else "on",
         "lock": "global" if args.global_lock else "sharded",
         "writes": "serial" if args.serial_writes else "batched",
@@ -410,6 +413,232 @@ def _wallclock_once(args, phases) -> dict:
     return result
 
 
+def _wallclock_once_sharded(args, phases) -> dict:
+    """One boot of the SHARDED process layout: N shard processes
+    (apiserver + WAL + manager each) under the consistent-hash ring,
+    the jupyter web app served over the ``ShardedKubeAPIServer``
+    router. The storm spreads notebooks across 2x-shards namespaces so
+    every shard owns real traffic; nodes are name-salted onto the
+    shard that schedules them (cluster-scoped objects route by name).
+
+    ``--shards 1`` never reaches this function — the single-process
+    arm (``_wallclock_once``) is preserved untouched."""
+    import secrets
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+    from collections import Counter
+
+    import requests
+
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        ShardedKubeAPIServer,
+    )
+    from kubeflow_rm_tpu.controlplane.shard import ShardRunner
+    from kubeflow_rm_tpu.controlplane.webapps.core import (
+        CSRF_COOKIE,
+        CSRF_HEADER,
+        USER_HEADER,
+        USER_PREFIX,
+    )
+
+    stop = threading.Event()
+    base_dir = tempfile.mkdtemp(prefix="conf-shards-")
+    runner = ShardRunner(args.shards, base_dir=base_dir,
+                         wal=not args.no_wal,
+                         manager_workers=args.manager_workers,
+                         hang_dump_s=args.hang_dump)
+    runner.start(timeout=120)
+
+    router = ShardedKubeAPIServer(runner.urls, identity="conformance-web",
+                                  qps=args.qps or None,
+                                  burst=args.burst or None)
+    # the web app reads through the router's merged informer cache —
+    # same kinds the single-process arm streams into its adapter
+    for kind in ("Notebook", "Event", "Pod", "PodDefault",
+                 "PersistentVolumeClaim", "RoleBinding", "Namespace"):
+        threading.Thread(target=router.watch_kind,
+                         args=(kind, None, stop, 60),
+                         daemon=True).start()
+    if not router.wait_for_sync(["Notebook", "Pod"], timeout=30):
+        raise AssertionError("router informers never synced")
+
+    accel = args.slices.split(",")[0].split("=")[0]
+    topo = tpu_api.lookup(accel)
+
+    # 2x-shards namespaces via the profile path; notebook i lands in
+    # conf-p{i % P}, so every shard owns live spawn traffic
+    n_profiles = 2 * args.shards
+    namespaces = [f"conf-p{p}" for p in range(n_profiles)]
+    ns_of = [namespaces[i % n_profiles] for i in range(args.notebooks)]
+
+    # salt the fleet: gang scheduling runs inside the shard that owns
+    # the notebook's namespace, and it can only see nodes living on
+    # that same shard (cluster-scoped -> routed by name)
+    per_shard = Counter(router.shard_of("Notebook", None, ns)
+                        for ns in ns_of)
+    for shard, n_slices in per_shard.items():
+        made, i = 0, 0
+        while made < n_slices * topo.hosts:
+            name = f"{accel}-{shard}-x{i}"
+            i += 1
+            if router.shard_of("Node", name, None) == shard:
+                router.create(make_tpu_node(name, accel))
+                made += 1
+
+    for ns in namespaces:
+        router.create(make_profile(ns, USER))
+    deadline = time.monotonic() + 60
+    for ns in namespaces:
+        while time.monotonic() < deadline:
+            if router.try_get("RoleBinding", "namespaceAdmin", ns):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"profile {ns} never reconciled")
+
+    # -- the web app: werkzeug over the shard router --
+    import logging as _logging
+
+    from werkzeug.serving import make_server
+
+    from kubeflow_rm_tpu.controlplane.webapps import jupyter as jwa
+    _logging.getLogger("werkzeug").setLevel(_logging.ERROR)
+    wsgi = jwa.create_app(router)
+    httpd = make_server("127.0.0.1", 0, wsgi, threaded=True)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    jwa_url = f"http://127.0.0.1:{httpd.server_port}"
+
+    def spawn_one(i: int) -> dict:
+        """Same storm body as the single-process arm, parameterized by
+        the notebook's ring namespace (see _wallclock_once for the
+        readiness-path commentary)."""
+        ns = ns_of[i]
+        s = requests.Session()
+        tok = secrets.token_urlsafe(16)
+        s.cookies.set(CSRF_COOKIE, tok)
+        s.headers[CSRF_HEADER] = tok
+        s.headers[USER_HEADER] = USER_PREFIX + USER
+        body = {
+            "name": f"wc-{i}",
+            "image": "ghcr.io/kubeflow-rm-tpu/jupyter-jax:latest",
+            "imagePullPolicy": "IfNotPresent",
+            "serverType": "jupyter", "cpu": "2", "memory": "8Gi",
+            "tpu": {"acceleratorType": accel},
+            "tolerationGroup": "none", "affinityConfig": "none",
+            "configurations": [], "shm": True, "environment": {},
+            "datavols": [],
+        }
+        t0 = time.perf_counter()
+        for attempt in range(3):
+            resp = s.post(
+                f"{jwa_url}/api/namespaces/{ns}/notebooks", json=body)
+            if resp.status_code == 200:
+                break
+            got = s.get(f"{jwa_url}/api/namespaces/{ns}/"
+                        f"notebooks/wc-{i}")
+            if got.status_code == 200:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"wc-{i} POST failed: {resp.text}")
+        phases.record("post_return", time.perf_counter() - t0)
+        slice_deadline = time.monotonic() + 180
+        status_gets = 0
+        readiness_gets = 0
+        if args.poll_readiness:
+            while True:
+                resp = s.get(f"{jwa_url}/api/namespaces/{ns}/"
+                             f"notebooks/wc-{i}")
+                status_gets += 1
+                nb = resp.json().get("notebook", {}) \
+                    if resp.status_code == 200 else {}
+                if (nb.get("status") or {}).get(
+                        "readyReplicas") == topo.hosts:
+                    break
+                if time.monotonic() > slice_deadline:
+                    raise AssertionError(
+                        f"wc-{i} never ready: {nb.get('status')}")
+                time.sleep(0.05)
+        else:
+            known = ""
+            while True:
+                resp = s.get(
+                    f"{jwa_url}/api/namespaces/{ns}/"
+                    f"notebooks/wc-{i}/readiness",
+                    params={"timeoutSeconds": 30,
+                            "knownVersion": known})
+                readiness_gets += 1
+                if resp.status_code == 200:
+                    nb = resp.json().get("notebook", {})
+                    if (nb.get("status") or {}).get(
+                            "readyReplicas") == topo.hosts:
+                        break
+                    known = str((nb.get("metadata") or {}).get(
+                        "resourceVersion") or "")
+                else:
+                    known = ""
+                if time.monotonic() > slice_deadline:
+                    raise AssertionError(
+                        f"wc-{i} never ready: "
+                        f"{resp.status_code} {resp.text[:200]}")
+        return {"latency": time.perf_counter() - t0,
+                "status_gets": status_gets,
+                "readiness_gets": readiness_gets}
+
+    t_start = time.perf_counter()
+    try:
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = max(1, args.concurrency)
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            spawns = list(pool.map(spawn_one, range(args.notebooks)))
+        latencies = [sp["latency"] for sp in spawns]
+        total = time.perf_counter() - t_start
+        # phases from the UNION of the shards' write logs: every
+        # shard stamps t from the same host clock, so cross-shard
+        # diffs are as poll-free as the single-process ones
+        merged: list[dict] = []
+        for url in runner.urls.values():
+            with urllib.request.urlopen(url + "/debug/writelog",
+                                        timeout=10) as r:
+                merged.extend(json.loads(r.read())["writes"])
+        merged.sort(key=lambda e: e["t"])
+        _phases_from_write_log(merged, "wc-", topo.hosts, phases)
+    finally:
+        stop.set()
+        httpd.shutdown()
+        runner.stop()
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    lat_sorted = sorted(latencies)
+    result = {
+        "notebooks": args.notebooks,
+        "concurrency": workers,
+        "slice": accel,
+        "hosts_per_slice": topo.hosts,
+        "provision_p50_ms": round(lat_sorted[len(latencies) // 2] * 1e3,
+                                  1),
+        "provision_p95_ms": round(
+            lat_sorted[max(0, int(len(latencies) * 0.95) - 1)] * 1e3, 1),
+        "total_s": round(total, 2),
+        "_readiness": {
+            "status_gets": sum(sp["status_gets"] for sp in spawns),
+            "readiness_gets": sum(sp["readiness_gets"]
+                                  for sp in spawns),
+        },
+    }
+    limiters = [c.limiter for c in router._clients.values()
+                if c.limiter is not None]
+    if limiters:
+        result["_throttle"] = {
+            "calls": sum(lim.throttled_calls for lim in limiters),
+            "seconds": sum(lim.throttled_seconds for lim in limiters),
+        }
+    return result
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--slices", default="v5p-16=2",
@@ -463,6 +692,18 @@ def main() -> int:
                          "suspension and preemptive gang-bind (the "
                          "oversubscription A/B baseline — "
                          "oversub_conformance.py is the full proof)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="wallclock mode: run the control plane as N "
+                         "shard PROCESSES under the consistent-hash "
+                         "ring (apiserver + WAL + manager each) with "
+                         "the web app over the shard router; 1 = the "
+                         "single-process arm, byte-for-byte today's "
+                         "path")
+    ap.add_argument("--no-wal", action="store_true",
+                    help="with --shards N>1: run the shards without "
+                         "the durable write-ahead log (the durability "
+                         "A/B baseline arm; --shards 1 never engages "
+                         "the WAL)")
     ap.add_argument("--hang-dump", type=float, default=0.0, metavar="S",
                     help="arm faulthandler to dump every thread's "
                          "stack after S seconds (CI contention-stress "

@@ -313,25 +313,40 @@ class _FastSession:
                        BrokenPipeError, ConnectionResetError,
                        ConnectionRefusedError, OSError)
         for attempt in (0, 1):
-            conn = self._pool.checkout() or self._connect(timeout or 60)
+            # the retry attempt always dials fresh: after a shard
+            # restart EVERY pooled socket is stale, and a checkout on
+            # attempt 1 would just pop the next dead keep-alive
+            conn = (self._pool.checkout() if attempt == 0 else None) \
+                or self._connect(timeout or 60)
             try:
                 conn.request(method, path, body=body, headers=hdrs)
-            except conn_errors:
+            except conn_errors as e:
                 # failed while SENDING on a stale keep-alive: the
                 # server never saw a complete request, so a resend
                 # is safe for any method
                 _close_quietly(conn)
                 if attempt:
                     raise
+                if isinstance(e, (ConnectionResetError,
+                                  BrokenPipeError)):
+                    # peer went away (shard restart), not a quietly
+                    # aged-out keep-alive: every idle socket in the
+                    # pool is equally dead — discard them all so the
+                    # reconcile loops behind this pool don't each eat
+                    # one stale socket
+                    self._pool.close()
                 continue
             try:
                 resp = _Resp(conn.getresponse(), eager=True)
-            except conn_errors:
+            except conn_errors as e:
                 # failed reading the RESPONSE: the server may have
                 # processed the request — only idempotent reads may
                 # retry (urllib3's default Retry excludes POST/PATCH
                 # for the same reason)
                 _close_quietly(conn)
+                if isinstance(e, (ConnectionResetError,
+                                  BrokenPipeError)):
+                    self._pool.close()  # shard restart: all stale
                 if attempt or method not in ("GET", "HEAD"):
                     raise
                 continue
@@ -816,13 +831,20 @@ class KubeAPIServer:
                 log.warning("watch %s: %s; retrying in 2s", kind, e)
                 stop.wait(2.0)
 
-    def _initial_list(self, kind: str, namespace: str | None) -> str:
+    def _list_raw(self, kind: str,
+                  namespace: str | None) -> tuple[list[dict], str]:
+        """One live list: (items, collection resourceVersion). The
+        shard router lists each shard through this and merges."""
         resp = self._session.get(self._collection_url(kind, namespace))
         self._raise_for(resp, f"list {kind}")
         body = resp.json()
         items = body.get("items", [])
         for item in items:
             item.setdefault("kind", kind)
+        return items, body.get("metadata", {}).get("resourceVersion", "")
+
+    def _initial_list(self, kind: str, namespace: str | None) -> str:
+        items, rv = self._list_raw(kind, namespace)
         if self._cache_reads and namespace is None:
             # (re)list replaces the kind's store contents — objects
             # deleted while the watch was down drop out, entries newer
@@ -834,12 +856,15 @@ class KubeAPIServer:
                 len(self.cache.synced_kinds()))
         for item in items:
             self._fan("ADDED", item)
-        return body.get("metadata", {}).get("resourceVersion", "")
+        return rv
 
     def _stream(self, kind: str, namespace: str | None, rv: str,
-                stop: threading.Event, timeout_s: int) -> str:
+                stop: threading.Event, timeout_s: int,
+                fan: Callable[[str, dict], None] | None = None) -> str:
         """One watch stream; returns the last resourceVersion seen so
-        the next stream resumes without a relist (informer resume)."""
+        the next stream resumes without a relist (informer resume).
+        ``fan`` overrides event delivery (the shard router injects its
+        merged-subscription fan)."""
         params = {"watch": "true",
                   "timeoutSeconds": str(timeout_s),
                   "allowWatchBookmarks": "true"}
@@ -870,7 +895,7 @@ class KubeAPIServer:
             seen = (obj.get("metadata") or {}).get("resourceVersion")
             if seen:
                 last_rv = seen
-            self._fan(etype, obj)
+            (fan or self._fan)(etype, obj)
         return last_rv
 
     def _fan(self, etype: str, obj: dict) -> None:
@@ -895,3 +920,409 @@ def strategic_patch_for(current: dict, desired: dict) -> dict:
     real apiserver we send merge-patch, which matches for the object
     shapes this platform writes (maps + whole-list replacement)."""
     return strategic_merge(current, desired)
+
+
+# ---- shard-aware router ----------------------------------------------
+# kinds replicated to EVERY shard instead of hashed: cluster-wide RBAC
+# must be visible to whichever shard evaluates a SubjectAccessReview
+# for its namespaces, and CRDs describe the schema every shard serves
+BROADCAST_KINDS = frozenset(
+    {"ClusterRole", "ClusterRoleBinding", "CustomResourceDefinition"})
+
+def _is_transient(e: Exception) -> bool:
+    # transport-level failures worth a routed retry: the shard is
+    # restarting (connection refused while it replays its WAL) or just
+    # restarted (every pooled keep-alive socket reset at once)
+    import http.client
+    return isinstance(e, (http.client.HTTPException, OSError)) \
+        and not isinstance(e, Invalid)
+
+
+class ShardedKubeAPIServer:
+    """One ``KubeAPIServer``-shaped client over N apiserver shards.
+
+    Routing: a namespaced object's NAMESPACE (a cluster-scoped
+    object's name) hashes onto the consistent ring — one shard owns
+    every object of a namespace, so per-object rv ordering, Conflict
+    semantics, quota, and the profile→namespace→children chain all
+    stay single-shard properties. ``BROADCAST_KINDS`` replicate to all
+    shards. Cluster-wide lists fan out and merge.
+
+    Retry-with-remap: a write hitting a restarting shard retries with
+    backoff inside ``retry_window_s``, re-resolving the ring each
+    attempt (the pooled stale sockets are dropped by ``_FastSession``'s
+    fresh-dial retry; the window covers WAL replay + rebind time).
+
+    Watch aggregation: ``watch_kind`` runs one list+stream loop PER
+    SHARD and merges events into one subscription feeding the
+    router-level ``ObjectStore`` and the registered watchers. Each
+    shard's resourceVersion sequence is tracked independently (per-
+    shard rv bookkeeping) — no global ordering exists or is claimed;
+    per-OBJECT ordering holds because an object lives on exactly one
+    shard. A shard's stream death falls back to a per-shard relist
+    that synthesizes DELETEDs for that shard's vanished objects only.
+    """
+
+    def __init__(self, shard_urls: dict[str, str], *,
+                 identity: str | None = None,
+                 qps: float | None = None, burst: int | None = None,
+                 retry_window_s: float = 10.0,
+                 clock: Callable[[], datetime.datetime] | None = None):
+        from kubeflow_rm_tpu.controlplane import metrics
+        from kubeflow_rm_tpu.controlplane.cache.store import ObjectStore
+        from kubeflow_rm_tpu.controlplane.shard.ring import HashRing
+        if not shard_urls:
+            raise Invalid("ShardedKubeAPIServer needs >= 1 shard url")
+        self.shard_urls = dict(shard_urls)
+        self.ring = HashRing(list(self.shard_urls))
+        self.retry_window_s = retry_window_s
+        self.identity = identity
+        self.clock = clock or (
+            lambda: datetime.datetime.now(datetime.timezone.utc))
+        # per-shard clients: caches OFF — the router owns the one
+        # merged informer store; double-caching would double memory
+        # and split rv bookkeeping
+        self._clients = {
+            name: KubeAPIServer(url, identity=identity, qps=qps,
+                                burst=burst, cache_reads=False)
+            for name, url in self.shard_urls.items()}
+        self.limiter = None
+        self._cache_reads = True
+        self.cache = ObjectStore(cluster_scoped={
+            k for k, (_, _, namespaced) in RESOURCES.items()
+            if not namespaced})
+        self._watchers: list[Callable[[str, dict, dict | None], None]] = []
+        # kind -> set of shards whose initial list completed (the
+        # router cache serves a kind once EVERY shard has listed it)
+        self._listed: dict[str, set[str]] = {}
+        self._listed_lock = threading.Lock()
+        metrics.SHARD_RING_MEMBERS.labels(
+            shard=metrics.shard_label()).set(len(self.ring))
+
+    # ---- routing -----------------------------------------------------
+    @staticmethod
+    def _partition_key(kind: str, name: str | None,
+                       namespace: str | None) -> str:
+        _, _, namespaced = RESOURCES.get(kind, (None, None, True))
+        return (namespace if namespaced else name) or ""
+
+    def _client_for(self, kind: str, name: str | None,
+                    namespace: str | None) -> "KubeAPIServer":
+        key = self._partition_key(kind, name, namespace)
+        return self._clients[self.ring.shard_for(key)]
+
+    def shard_of(self, kind: str, name: str | None,
+                 namespace: str | None) -> str:
+        return self.ring.shard_for(
+            self._partition_key(kind, name, namespace))
+
+    def _routed(self, kind: str, name: str | None,
+                namespace: str | None, fn: Callable, *,
+                lost_reply: dict | None = None):
+        """Run ``fn(client)`` against the owning shard, retrying with
+        remap on transport failures inside the retry window (a
+        restarting shard refuses connections while it replays its
+        WAL; it rejoins the ring at the same position).
+
+        ``lost_reply`` maps APIError types to ``handler(client)`` for
+        the at-least-once ambiguity: a crashed shard may have
+        COMMITTED the verb to its WAL with the reply lost in flight,
+        so the retry's AlreadyExists (create) or NotFound (delete) IS
+        success. Only consulted after a transport retry — a
+        first-attempt conflict is a genuine caller error."""
+        deadline = time.monotonic() + self.retry_window_s
+        delay = 0.1
+        retried = False
+        while True:
+            client = self._client_for(kind, name, namespace)
+            try:
+                return fn(client)
+            except APIError as e:
+                if retried and lost_reply:
+                    for etype, handler in lost_reply.items():
+                        if isinstance(e, etype):
+                            log.debug(
+                                "%s after shard retry: treating as "
+                                "lost reply of a committed %s", type(e).
+                                __name__, kind)
+                            return handler(client)
+                raise
+            except Exception as e:
+                if not _is_transient(e) or time.monotonic() > deadline:
+                    raise
+                log.debug("shard %s unreachable (%s); retrying",
+                          self.shard_of(kind, name, namespace), e)
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+                retried = True
+
+    # ---- wiring ------------------------------------------------------
+    def register_admission(self, kind_pattern: str, fn: Callable) -> None:
+        log.debug("admission for %s runs inside each shard", kind_pattern)
+
+    def register_validator(self, kind: str, fn: Callable) -> None:
+        log.debug("validation for %s runs inside each shard", kind)
+
+    def add_watcher(self, fn: Callable[[str, dict, dict | None], None],
+                    name: str | None = None) -> None:
+        self._watchers.append(fn)
+
+    def wait_for_sync(self, kinds, timeout: float | None = None) -> bool:
+        return self.cache.wait_for_sync(kinds, timeout)
+
+    def _cache_serves(self, kind: str) -> bool:
+        return self.cache.is_synced(kind)
+
+    # ---- verbs -------------------------------------------------------
+    def create(self, obj: dict) -> dict:
+        kind = obj["kind"]
+        if kind in BROADCAST_KINDS:
+            out = None
+            for client in self._clients.values():
+                try:
+                    res = client.create(obj)
+                except AlreadyExists:
+                    res = client.get(kind, name_of(obj))
+                out = out or res
+            self.cache.apply("ADDED", out)
+            return out
+        out = self._routed(
+            kind, name_of(obj), namespace_of(obj),
+            lambda c: c.create(obj),
+            lost_reply={AlreadyExists: lambda c: c.get(
+                kind, name_of(obj), namespace_of(obj))})
+        self.cache.apply("ADDED", out)
+        return out
+
+    def create_many(self, objs: list[dict]) -> list[dict]:
+        if not objs:
+            return []
+        kind = objs[0]["kind"]
+        # one bulk POST per namespace (the collection URL carries the
+        # namespace); each namespace lives wholly on one shard
+        by_ns: dict[str | None, list[int]] = {}
+        for i, o in enumerate(objs):
+            by_ns.setdefault(namespace_of(o), []).append(i)
+        results: list = [None] * len(objs)
+        for _ns, idxs in by_ns.items():
+            batch = [objs[i] for i in idxs]
+
+            def one_by_one(c, b=batch):
+                # lost-reply replay of a bulk POST: re-create each
+                # object individually, absorbing the ones that landed
+                outs = []
+                for o in b:
+                    try:
+                        outs.append(c.create(o))
+                    except AlreadyExists:
+                        outs.append(c.get(kind, name_of(o),
+                                          namespace_of(o)))
+                return outs
+
+            outs = self._routed(
+                kind, name_of(batch[0]), namespace_of(batch[0]),
+                lambda c, b=batch: c.create_many(b),
+                lost_reply={AlreadyExists: one_by_one})
+            for i, out in zip(idxs, outs):
+                results[i] = out
+                if not (out or {}).get("kind") == "Status":
+                    self.cache.apply("ADDED", out)
+        return results
+
+    def get(self, kind: str, name: str,
+            namespace: str | None = None) -> dict:
+        if self._cache_serves(kind):
+            obj = self.cache.get_ref(kind, name, namespace)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return fast_deepcopy(obj)
+        return self._routed(kind, name, namespace,
+                            lambda c: c.get(kind, name, namespace))
+
+    def try_get(self, kind: str, name: str,
+                namespace: str | None = None) -> dict | None:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict | None = None) -> list[dict]:
+        if self._cache_serves(kind):
+            return [fast_deepcopy(o) for o in
+                    self.cache.list_refs(kind, namespace, label_selector)]
+        _, _, namespaced = RESOURCES.get(kind, (None, None, True))
+        if namespaced and namespace is not None:
+            return self._routed(
+                kind, None, namespace,
+                lambda c: c.list(kind, namespace, label_selector))
+        # cluster-wide list: fan out and merge, deduping the broadcast
+        # and cluster-scoped kinds by name (every shard holds a copy
+        # of e.g. the "kubeflow" Namespace it needs locally)
+        merged: dict[tuple, dict] = {}
+        for client in self._clients.values():
+            for o in client.list(kind, namespace, label_selector):
+                merged.setdefault(
+                    (namespace_of(o), name_of(o)), o)
+        out = list(merged.values())
+        out.sort(key=lambda o: (namespace_of(o) or "", name_of(o)))
+        return out
+
+    def scan(self, kind: str, namespace: str | None = None) -> list[dict]:
+        if self._cache_serves(kind):
+            return self.cache.list_refs(kind, namespace)
+        return self.list(kind, namespace)
+
+    def update(self, obj: dict) -> dict:
+        kind = obj["kind"]
+        out = self._routed(kind, name_of(obj), namespace_of(obj),
+                           lambda c: c.update(obj))
+        self.cache.apply("MODIFIED", out)
+        return out
+
+    def patch(self, kind: str, name: str, patch: dict,
+              namespace: str | None = None) -> dict:
+        out = self._routed(kind, name, namespace,
+                           lambda c: c.patch(kind, name, patch, namespace))
+        self.cache.apply("MODIFIED", out)
+        return out
+
+    def update_status(self, obj: dict) -> dict:
+        out = self._routed(obj["kind"], name_of(obj), namespace_of(obj),
+                           lambda c: c.update_status(obj))
+        self.cache.apply("MODIFIED", out)
+        return out
+
+    def delete(self, kind: str, name: str,
+               namespace: str | None = None) -> None:
+        if kind in BROADCAST_KINDS:
+            for client in self._clients.values():
+                try:
+                    client.delete(kind, name, namespace)
+                except NotFound:
+                    pass
+        else:
+            self._routed(kind, name, namespace,
+                         lambda c: c.delete(kind, name, namespace),
+                         lost_reply={NotFound: lambda c: None})
+        self.cache.discard(kind, name, namespace)
+
+    def ensure_namespace(self, namespace: str) -> dict:
+        return self._routed(
+            "Namespace", namespace, None,
+            lambda c: c.ensure_namespace(namespace))
+
+    def record_event(self, involved: dict, etype: str, reason: str,
+                     message: str) -> dict:
+        ns = namespace_of(involved) or "default"
+        return self._routed(
+            "Event", None, ns,
+            lambda c: c.record_event(involved, etype, reason, message))
+
+    def events_for(self, involved: dict) -> list[dict]:
+        ns = namespace_of(involved)
+        if self._cache_serves("Event"):
+            return [fast_deepcopy(e) for e in self.cache.events_for_ref(
+                involved["kind"], name_of(involved), ns)]
+        return self._routed("Event", None, ns or "default",
+                            lambda c: c.events_for(involved))
+
+    def pod_logs(self, namespace: str, pod_name: str,
+                 tail_lines: int | None = None) -> str:
+        return self._routed(
+            "Pod", pod_name, namespace,
+            lambda c: c.pod_logs(namespace, pod_name, tail_lines))
+
+    def access_review(self, user: str | None, verb: str, resource: str,
+                      namespace: str | None = None) -> bool:
+        return self._routed(
+            "Namespace" if namespace is None else "Pod",
+            namespace or "", namespace,
+            lambda c: c.access_review(user, verb, resource, namespace))
+
+    # ---- cross-shard watch aggregation -------------------------------
+    def watch_kind(self, kind: str, namespace: str | None = None,
+                   stop: threading.Event | None = None,
+                   timeout_s: int = 300) -> None:
+        """Merged subscription: one list+stream loop per shard, all
+        feeding the router store + watchers. Blocks until ``stop``."""
+        stop = stop or threading.Event()
+        threads = [
+            threading.Thread(
+                target=self._watch_shard, daemon=True,
+                name=f"router-watch-{kind}-{shard}",
+                args=(shard, kind, namespace, stop, timeout_s))
+            for shard in self._clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _watch_shard(self, shard: str, kind: str,
+                     namespace: str | None, stop: threading.Event,
+                     timeout_s: int) -> None:
+        client = self._clients[shard]
+        fan = self._shard_fan(shard)
+        rv: str | None = None
+        while not stop.is_set():
+            try:
+                if rv is None:
+                    items, rv = client._list_raw(kind, namespace)
+                    self._merge_shard_list(shard, kind, items)
+                rv = client._stream(kind, namespace, rv, stop,
+                                    timeout_s, fan=fan)
+            except (NotFound, Invalid):
+                raise  # misconfigured kind: crash loudly
+            except _WatchExpired as e:
+                log.info("watch %s@%s: %s; relisting", kind, shard, e)
+                rv = None
+            except Exception as e:
+                # shard down (restarting): relist once it's back so
+                # deletes that raced the outage aren't missed
+                log.debug("watch %s@%s: %s; retrying", kind, shard, e)
+                rv = None
+                stop.wait(1.0)
+
+    def _merge_shard_list(self, shard: str, kind: str,
+                          items: list[dict]) -> None:
+        """Fold one shard's (re)list into the merged store: upsert
+        everything listed (rv-guarded), synthesize DELETED for THIS
+        shard's entries that vanished while its watch was down, and
+        mark the kind synced once every shard has listed."""
+        present = set()
+        for item in items:
+            present.add(self.cache.key_for(
+                kind, name_of(item), namespace_of(item)))
+        stale = [
+            ref for ref in self.cache.list_refs(kind)
+            if self.cache.key_for(kind, name_of(ref), namespace_of(ref))
+            not in present
+            and kind not in BROADCAST_KINDS
+            and self.shard_of(kind, name_of(ref),
+                              namespace_of(ref)) == shard]
+        fan = self._shard_fan(shard)
+        for ref in stale:
+            fan("DELETED", fast_deepcopy(ref))
+        for item in items:
+            fan("ADDED", item)
+        with self._listed_lock:
+            listed = self._listed.setdefault(kind, set())
+            listed.add(shard)
+            if listed >= set(self._clients):
+                self.cache.mark_synced(kind)
+
+    def _shard_fan(self, shard: str) -> Callable[[str, dict], None]:
+        def fan(etype: str, obj: dict) -> None:
+            from kubeflow_rm_tpu.controlplane import metrics
+            self.cache.apply(etype, obj)
+            kind = obj.get("kind")
+            if kind:
+                metrics.INFORMER_EVENTS_TOTAL.labels(kind=kind).inc()
+            metrics.INFORMER_LAST_EVENT_TIMESTAMP.set(time.time())
+            for w in list(self._watchers):
+                try:
+                    w(etype, obj, None)
+                except Exception:
+                    log.exception("router watcher failed on %s %s",
+                                  etype, kind)
+        return fan

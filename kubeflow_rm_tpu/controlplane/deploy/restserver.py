@@ -183,8 +183,11 @@ class RestServer:
         self._backlog: collections.deque = collections.deque(maxlen=2048)
         # rv horizon of the backlog: anything <= this may have been
         # evicted, so a watch asking to resume below it gets 410 Gone
-        # (the informer then relists — kubeclient.watch_kind)
-        self._backlog_floor = 0
+        # (the informer then relists — kubeclient.watch_kind). Starts
+        # at the server's CURRENT rv: after a WAL-recovered restart the
+        # pre-crash event stream is gone, so a client resuming at a
+        # pre-crash rv must relist rather than silently miss the gap.
+        self._backlog_floor = int(getattr(api, "_rv", 0) or 0)
         self._watch_lock = threading.Lock()
         api.add_watcher(self._on_event, name="rest")
 
@@ -251,6 +254,13 @@ class RestServer:
         if parsed.path in ("/healthz", "/readyz", "/livez"):
             self._send_raw(handler, 200, b"ok",
                            content_type="text/plain")
+            return
+        if parsed.path == "/debug/writelog" and method == "GET":
+            # the apiserver's bounded write audit trail, serialized for
+            # out-of-process consumers (the sharded conformance harness
+            # reconstructs cross-shard phase breakdowns from these)
+            self._send(handler, 200,
+                       {"writes": list(self.api.write_log)})
             return
         if parsed.path == "/metrics" and method == "GET":
             # Prometheus exposition of the control-plane registry —
@@ -571,6 +581,20 @@ class RestServer:
             # provision latency for whichever stream loses the race
             request_queue_size = 128
 
+            # accepted sockets, so stop() can sever ESTABLISHED
+            # keep-alive connections: shutdown()+server_close() only
+            # stop the accept loop, leaving handler threads serving
+            # pooled clients as if the shard never went down
+            def get_request(self):
+                sock, addr = super().get_request()
+                with self._conn_lock:
+                    self._conns = {c for c in self._conns
+                                   if c.fileno() != -1}
+                    self._conns.add(sock)
+                return sock, addr
+
+        S._conns = set()
+        S._conn_lock = threading.Lock()
         self._httpd = S(("127.0.0.1", self.port), H)
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
@@ -583,3 +607,24 @@ class RestServer:
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
+            # release the listening socket too: without this the port
+            # stays bound (the kernel keeps SYN-queueing clients into a
+            # backlog nobody drains) and a restart-in-place at the same
+            # address — the shard respawn path — gets EADDRINUSE
+            self._httpd.server_close()
+            # and sever established connections: a "stopped" server
+            # must stop answering, or pooled keep-alive clients keep
+            # getting clean replies from a shard that is supposed to
+            # be down (their retry/lost-reply paths never engage)
+            import socket as _socket
+            with self._httpd._conn_lock:
+                conns, self._httpd._conns = set(self._httpd._conns), set()
+            for sock in conns:
+                try:
+                    sock.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass

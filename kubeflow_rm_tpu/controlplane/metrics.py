@@ -285,6 +285,57 @@ SUSPEND_RESUME_SECONDS = Histogram(
 )
 
 
+# ---- sharded control plane: durable WAL + snapshot + ring ------------
+# Every gauge below carries a ``shard`` label: each shard runs in its
+# own process with its own registry, so the label is what lets a
+# fleet-level scrape (or the /api/metrics facade aggregating shard
+# scrapes) tell the per-shard series apart.
+WAL_FSYNC_SECONDS = Histogram(
+    "wal_fsync_seconds",
+    "Group-commit flush latency: buffered frames written + fsynced in "
+    "one batch (etcd's wal_fsync_duration_seconds analogue)",
+    ["shard"],
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0),
+    registry=REGISTRY,
+)
+WAL_BYTES_TOTAL = Counter(
+    "wal_bytes",
+    "Bytes appended to the write-ahead log (CRC frame headers included)",
+    ["shard"],
+    registry=REGISTRY,
+)
+SNAPSHOT_DURATION_SECONDS = Histogram(
+    "snapshot_duration_seconds",
+    "Compacting-snapshot write latency: cut under the write lock, "
+    "serialize, fsync, rename, drop compacted WAL segments",
+    ["shard"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+    registry=REGISTRY,
+)
+SHARD_RING_MEMBERS = Gauge(
+    "shard_ring_members",
+    "Shards on the consistent-hash ring this process routes to (router) "
+    "or participates in (shard worker)",
+    ["shard"],
+    registry=REGISTRY,
+)
+
+# the shard identity this process reports under — "" outside sharded
+# deployments so single-process metrics stay label-stable
+_SHARD = ""
+
+
+def set_shard(name: str) -> None:
+    """Tag this process's per-shard metric series (shard worker boot /
+    router construction call this once)."""
+    global _SHARD
+    _SHARD = name
+
+
+def shard_label() -> str:
+    return _SHARD
+
+
 def registry_value(sample_name: str,
                    labels: dict[str, str] | None = None) -> float:
     """Sum the current value of all samples named ``sample_name``

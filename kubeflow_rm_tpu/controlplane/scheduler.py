@@ -278,6 +278,19 @@ class SchedulerCache:
         for _ in range(4):
             with self._nlock:
                 nodes = list(self._nodes.values())
+            # best-fragmentation-fit ordering (ParvaGPU's allocation
+            # tiebreak): try the nodes with the LEAST free capacity
+            # first, so a gang soaks up already-fragmented remainders
+            # and the emptiest nodes stay whole for future large gangs
+            # — first-fit in arrival order eroded largest_free_gang by
+            # carving every new gang out of the freest node. Free is
+            # snapshotted once per attempt; name breaks ties so plans
+            # are deterministic.
+            free0: dict[str, float] = {}
+            for node in nodes:
+                with node.lock:
+                    free0[node.name] = node.capacity - node.used
+            nodes.sort(key=lambda n: (free0[n.name], n.name))
             plan: dict[tuple, str] = {}
             tentative: dict[str, float] = {}
             for pod in sorted(pods, key=name_of):

@@ -1,0 +1,18 @@
+"""Horizontal sharding of the control plane.
+
+The apiserver partitions by NAMESPACE under a consistent-hash ring
+(``ring.py``): every object of a namespace — and the cluster-scoped
+objects keyed by the same name, like a Profile and the Namespace it
+owns — lives on exactly one shard, so single-shard semantics (rv
+ordering, Conflict CAS, quota, admission) are preserved per object
+with zero cross-shard coordination. ``worker.py`` is one shard's
+process (apiserver + WAL + kubelet + REST + elected platform
+manager); ``runner.py`` supervises N of them and respawns a killed
+shard in place; the client-side router lives in
+``deploy.kubeclient.ShardedKubeAPIServer``.
+"""
+
+from kubeflow_rm_tpu.controlplane.shard.ring import DEFAULT_VNODES, HashRing
+from kubeflow_rm_tpu.controlplane.shard.runner import ShardRunner
+
+__all__ = ["HashRing", "DEFAULT_VNODES", "ShardRunner"]

@@ -1,0 +1,61 @@
+"""Consistent-hash ring partitioning the control plane by namespace.
+
+Deterministic across processes (md5, no seed): the router in the
+client, every shard worker, and the conformance harness all compute
+the same ``shard_for(namespace)`` with no coordination. Virtual nodes
+smooth the partition (#vnodes ≫ #shards keeps the largest shard within
+a few percent of fair share); membership is fixed for a deployment —
+a restarted shard rejoins under the same name at the same position, so
+"retry-with-remap" on the client resolves to the same shard once it is
+back (remap matters when a deployment is later resized).
+
+Partition key: a namespaced object's namespace; a cluster-scoped
+object's NAME (Profile "alice" and Namespace "alice" hash identically,
+keeping a profile, its namespace, and everything inside on one shard).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+DEFAULT_VNODES = 64
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(
+        hashlib.md5(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    def __init__(self, members: list[str], *,
+                 vnodes: int = DEFAULT_VNODES):
+        if not members:
+            raise ValueError("HashRing needs at least one member")
+        self.members = sorted(members)
+        self._points: list[int] = []
+        self._owners: list[str] = []
+        pairs = sorted(
+            (_hash(f"{m}#{v}"), m)
+            for m in self.members for v in range(vnodes))
+        for point, owner in pairs:
+            self._points.append(point)
+            self._owners.append(owner)
+
+    def shard_for(self, key: str | None) -> str:
+        """The member owning ``key`` (a namespace, or a cluster-scoped
+        object's name). ``None`` — e.g. a cluster-wide list — is the
+        caller's cue to fan out, but routes deterministically here."""
+        i = bisect.bisect_right(self._points, _hash(key or "")) \
+            % len(self._points)
+        return self._owners[i]
+
+    def spread(self, keys) -> dict[str, list[str]]:
+        """Group ``keys`` by owning member (routing bulk writes)."""
+        out: dict[str, list[str]] = {m: [] for m in self.members}
+        for k in keys:
+            out[self.shard_for(k)].append(k)
+        return out
+
+    def __len__(self) -> int:
+        return len(self.members)

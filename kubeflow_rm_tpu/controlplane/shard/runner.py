@@ -1,0 +1,151 @@
+"""Shard supervisor: N worker processes + respawn-in-place.
+
+``ShardRunner`` owns the deployment topology: it picks one fixed port
+and one WAL directory per shard BEFORE anything starts (the ring and
+every client derive from this map, and a respawned shard must rebind
+the same port and replay the same WAL), spawns each worker via
+``multiprocessing`` spawn (no forked locks/sockets from the parent),
+health-waits on ``/healthz``, and supervises — a shard that dies
+without being asked (or is SIGKILLed by the chaos test) is respawned
+in place, where its boot path replays snapshot + WAL and rejoins the
+ring at the same position.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.request
+
+from kubeflow_rm_tpu.controlplane.shard.worker import shard_worker_main
+
+log = logging.getLogger("kubeflow_rm_tpu.shard.runner")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ShardRunner:
+    def __init__(self, n_shards: int, *, base_dir: str | None = None,
+                 wal: bool = True, manager_workers: int = 8,
+                 auto_ready: bool = True, hang_dump_s: float = 0.0,
+                 supervise: bool = True):
+        if n_shards < 1:
+            raise ValueError("need at least one shard")
+        self._ctx = multiprocessing.get_context("spawn")
+        self._procs: dict[str, multiprocessing.process.BaseProcess] = {}
+        self._cfgs: dict[str, dict] = {}
+        self._stopping = False
+        self._lock = threading.Lock()
+        self._supervise = supervise
+        for i in range(n_shards):
+            name = f"shard-{i}"
+            wal_dir = None
+            if wal:
+                wal_dir = os.path.join(
+                    base_dir or ".", "wal", name)
+                os.makedirs(wal_dir, exist_ok=True)
+            self._cfgs[name] = {
+                "name": name, "port": _free_port(), "wal_dir": wal_dir,
+                "manager_workers": manager_workers,
+                "auto_ready": auto_ready, "hang_dump_s": hang_dump_s,
+            }
+
+    # ---- topology ----------------------------------------------------
+    @property
+    def names(self) -> list[str]:
+        return list(self._cfgs)
+
+    @property
+    def urls(self) -> dict[str, str]:
+        return {n: f"http://127.0.0.1:{c['port']}"
+                for n, c in self._cfgs.items()}
+
+    def wal_dir(self, name: str) -> str | None:
+        return self._cfgs[name]["wal_dir"]
+
+    # ---- lifecycle ---------------------------------------------------
+    def start(self, timeout: float = 60.0) -> None:
+        for name in self._cfgs:
+            self._spawn(name)
+        self.wait_ready(timeout)
+        if self._supervise:
+            threading.Thread(target=self._watchdog, daemon=True,
+                             name="shard-watchdog").start()
+
+    def _spawn(self, name: str) -> None:
+        p = self._ctx.Process(target=shard_worker_main,
+                              args=(self._cfgs[name],),
+                              name=name, daemon=True)
+        p.start()
+        self._procs[name] = p
+        log.info("spawned %s pid=%d port=%d", name, p.pid,
+                 self._cfgs[name]["port"])
+
+    def wait_ready(self, timeout: float = 60.0,
+                   names: list[str] | None = None) -> None:
+        deadline = time.monotonic() + timeout
+        for name in names or self.names:
+            url = self.urls[name] + "/healthz"
+            while True:
+                try:
+                    with urllib.request.urlopen(url, timeout=2) as r:
+                        if r.status == 200:
+                            break
+                except OSError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"{name} never became healthy "
+                                       f"at {url}")
+                time.sleep(0.05)
+
+    def kill(self, name: str) -> int:
+        """SIGKILL one shard (the chaos verb). The watchdog — or an
+        explicit ``respawn`` — brings it back at the same port + WAL
+        directory, which is the whole point: recovery is replay, not
+        re-provisioning."""
+        p = self._procs[name]
+        pid = p.pid
+        os.kill(pid, signal.SIGKILL)
+        p.join(timeout=10)
+        return pid
+
+    def respawn(self, name: str, timeout: float = 60.0) -> None:
+        with self._lock:
+            p = self._procs.get(name)
+            if p is not None and p.is_alive():
+                return
+            self._spawn(name)
+        self.wait_ready(timeout, names=[name])
+
+    def _watchdog(self) -> None:
+        while not self._stopping:
+            time.sleep(0.2)
+            for name, p in list(self._procs.items()):
+                if self._stopping or p.is_alive():
+                    continue
+                log.warning("%s exited (code %s); respawning in place",
+                            name, p.exitcode)
+                with self._lock:
+                    if not self._stopping and \
+                            not self._procs[name].is_alive():
+                        self._spawn(name)
+
+    def stop(self) -> None:
+        self._stopping = True
+        for p in self._procs.values():
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs.values():
+            p.join(timeout=10)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=5)

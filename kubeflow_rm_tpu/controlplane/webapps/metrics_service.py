@@ -181,6 +181,24 @@ def _controlplane_section(api=None) -> dict:
                 for p in ("drain", "rebind", "restore")
             },
         },
+        # durable sharded control plane: WAL group-commit and snapshot
+        # health plus ring membership. shard is THIS process's identity
+        # ("" = unsharded); counters sum across shard labels when a
+        # single registry hosts several (in-thread test stacks)
+        "persistence": {
+            "shard": cp_metrics.shard_label() or None,
+            "ring_members": cp_metrics.registry_value(
+                "shard_ring_members"),
+            "wal_fsyncs": cp_metrics.registry_value(
+                "wal_fsync_seconds_count"),
+            "wal_fsync_s": cp_metrics.registry_value(
+                "wal_fsync_seconds_sum"),
+            "wal_bytes": cp_metrics.registry_value("wal_bytes_total"),
+            "snapshots": cp_metrics.registry_value(
+                "snapshot_duration_seconds_count"),
+            "snapshot_s": cp_metrics.registry_value(
+                "snapshot_duration_seconds_sum"),
+        },
         # push readiness: long-polls currently parked on the hub and
         # the event-arrival -> waiter-observation latency that replaced
         # the clients' fixed-interval status polling
@@ -365,6 +383,20 @@ class PrometheusMetricsService:
                         "seconds": g.get(
                             "suspend_resume_phase_seconds_sum"),
                     },
+                },
+                # shard labels summed by the flat scrape: fleet-wide
+                # WAL/snapshot totals (per-shard split needs the
+                # labelled exposition, not this backend)
+                "persistence": {
+                    "shard": None,
+                    "ring_members": g.get("shard_ring_members"),
+                    "wal_fsyncs": g.get("wal_fsync_seconds_count"),
+                    "wal_fsync_s": g.get("wal_fsync_seconds_sum"),
+                    "wal_bytes": g.get("wal_bytes_total"),
+                    "snapshots": g.get(
+                        "snapshot_duration_seconds_count"),
+                    "snapshot_s": g.get(
+                        "snapshot_duration_seconds_sum"),
                 },
                 "readiness": {
                     "waiters": g.get("readiness_waiters"),

@@ -402,3 +402,26 @@ def test_stats_fragmentation_gauge():
     s = cache.stats()
     assert s["free_chips"] == 0.0
     assert s["fragmentation"] == 0.0
+
+
+def test_gang_bind_prefers_best_fragmentation_fit():
+    """ParvaGPU-style placement tiebreak: a gang lands on the node
+    whose free capacity fits it TIGHTEST, not the first node in
+    arrival order — so partially-used hosts absorb small gangs and
+    the emptiest hosts keep their largest_free_gang intact."""
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_node("n0", 8))                      # free 8
+    api.create(_node("n1", 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+    cache.observe("ADDED", api.create(_pod("frag", 2, node="n1")))  # free 6
+
+    # a 6-chip gang fits both nodes; first-fit-in-order would carve it
+    # out of pristine n0 (leaving free [2, 6] -> largest gang 6);
+    # best-fit takes fragmented n1 whole, preserving n0's 8
+    plan = cache.gang_bind([_pod("g0", 6)], allow_virtual=False)
+    assert plan == {("d", "g0"): "n1"}
+    s = cache.stats()
+    assert s["largest_free_gang"] == 8.0
+    assert s["free_chips"] == 8.0

@@ -1,0 +1,247 @@
+"""Sharded control plane: consistent-hash ring properties and the
+``ShardedKubeAPIServer`` router — namespace routing, broadcast kinds,
+cluster-wide list merge, cross-shard watch aggregation, and
+retry-with-remap across a shard restart. Uses an in-thread two-shard
+stack (two in-memory apiservers behind REST facades); the process
+topology itself is conformance/e2e_walk.py's job."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer, NotFound
+from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+    BROADCAST_KINDS,
+    ShardedKubeAPIServer,
+)
+from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+from kubeflow_rm_tpu.controlplane.shard.ring import HashRing
+
+
+# ---- ring ------------------------------------------------------------
+
+def test_ring_is_deterministic_across_instances():
+    a = HashRing(["s0", "s1", "s2"])
+    b = HashRing(["s2", "s0", "s1"])  # member order must not matter
+    for i in range(200):
+        key = f"ns-{i}"
+        assert a.shard_for(key) == b.shard_for(key)
+
+
+def test_ring_balance_within_tolerance():
+    ring = HashRing([f"s{i}" for i in range(4)])
+    spread = ring.spread(f"ns-{i}" for i in range(2000))
+    sizes = sorted(len(v) for v in spread.values())
+    assert sizes[0] > 0
+    # vnode smoothing: largest partition within ~2x of fair share
+    assert sizes[-1] < 2 * (2000 / 4)
+
+
+def test_ring_remap_is_minimal_on_resize():
+    """Consistent hashing's point: growing 3 -> 4 shards moves only
+    ~1/4 of the keyspace, not everything (a mod-N scheme moves ~3/4)."""
+    before = HashRing(["s0", "s1", "s2"])
+    after = HashRing(["s0", "s1", "s2", "s3"])
+    keys = [f"ns-{i}" for i in range(1000)]
+    moved = sum(before.shard_for(k) != after.shard_for(k) for k in keys)
+    assert moved < 500
+
+
+# ---- router over an in-thread 2-shard stack --------------------------
+
+class _Stack:
+    def __init__(self):
+        self.apis: dict[str, APIServer] = {}
+        self.rests: dict[str, RestServer] = {}
+        self.urls: dict[str, str] = {}
+        for name in ("shard-0", "shard-1"):
+            api = APIServer(shard=name)
+            rest = RestServer(api)
+            rest.start()
+            self.apis[name] = api
+            self.rests[name] = rest
+            self.urls[name] = rest.url
+
+    def stop(self):
+        for rest in self.rests.values():
+            rest.stop()
+
+
+@pytest.fixture()
+def stack():
+    s = _Stack()
+    yield s
+    s.stop()
+
+
+def _pod(name: str, ns: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"containers": [{"name": "c", "image": "img"}]}}
+
+
+def test_router_partitions_by_namespace(stack):
+    router = ShardedKubeAPIServer(stack.urls)
+    # find two namespaces living on different shards
+    ns_by_shard: dict[str, str] = {}
+    i = 0
+    while len(ns_by_shard) < 2:
+        ns = f"ns-{i}"
+        i += 1
+        ns_by_shard.setdefault(router.shard_of("Pod", None, ns), ns)
+    for shard, ns in ns_by_shard.items():
+        router.ensure_namespace(ns)
+        router.create(_pod("p0", ns))
+        # the object physically lives ONLY on its ring owner
+        assert stack.apis[shard].try_get("Pod", "p0", ns) is not None
+        for other, api in stack.apis.items():
+            if other != shard:
+                assert api.try_get("Pod", "p0", ns) is None
+        # and reads route back to it
+        assert router.get("Pod", "p0", ns)["metadata"]["namespace"] == ns
+
+
+def test_router_cluster_scoped_routes_by_name(stack):
+    router = ShardedKubeAPIServer(stack.urls)
+    node = {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "node-a", "labels": {}},
+            "status": {"allocatable": {}, "capacity": {}}}
+    router.create(node)
+    owner = router.shard_of("Node", "node-a", None)
+    assert stack.apis[owner].try_get("Node", "node-a") is not None
+    assert router.get("Node", "node-a")["metadata"]["name"] == "node-a"
+
+
+def test_router_broadcast_kinds_replicate_everywhere(stack):
+    router = ShardedKubeAPIServer(stack.urls)
+    assert "ClusterRole" in BROADCAST_KINDS
+    cr = {"apiVersion": "rbac.authorization.k8s.io/v1",
+          "kind": "ClusterRole", "metadata": {"name": "admin-all"},
+          "rules": []}
+    router.create(cr)
+    for api in stack.apis.values():
+        assert api.try_get("ClusterRole", "admin-all") is not None
+    # cluster-wide list dedups the replicas back to one
+    assert len(router.list("ClusterRole")) == 1
+    router.delete("ClusterRole", "admin-all")
+    for api in stack.apis.values():
+        assert api.try_get("ClusterRole", "admin-all") is None
+
+
+def test_router_cluster_wide_list_merges_shards(stack):
+    router = ShardedKubeAPIServer(stack.urls)
+    names: list[str] = []
+    seen_shards = set()
+    i = 0
+    while len(seen_shards) < 2 or len(names) < 4:
+        ns = f"m-{i}"
+        i += 1
+        seen_shards.add(router.shard_of("Pod", None, ns))
+        router.ensure_namespace(ns)
+        router.create(_pod("p", ns))
+        names.append(ns)
+    merged = router.list("Pod")
+    assert sorted(p["metadata"]["namespace"] for p in merged) == \
+        sorted(names)
+
+
+def test_router_watch_aggregates_both_shards(stack):
+    router = ShardedKubeAPIServer(stack.urls)
+    events: list[tuple] = []
+    router.add_watcher(
+        lambda et, obj, old=None: events.append(
+            (et, obj["metadata"]["namespace"])), name="t")
+    stop = threading.Event()
+    t = threading.Thread(target=router.watch_kind,
+                         args=("Pod", None, stop, 30), daemon=True)
+    t.start()
+    assert router.wait_for_sync(["Pod"], timeout=10)
+
+    # one namespace per shard -> events must arrive from BOTH streams
+    ns_by_shard: dict[str, str] = {}
+    i = 0
+    while len(ns_by_shard) < 2:
+        ns = f"w-{i}"
+        i += 1
+        ns_by_shard.setdefault(router.shard_of("Pod", None, ns), ns)
+    for ns in ns_by_shard.values():
+        router.ensure_namespace(ns)
+        router.create(_pod("p0", ns))
+    deadline = time.monotonic() + 10
+    want = {("ADDED", ns) for ns in ns_by_shard.values()}
+    while time.monotonic() < deadline:
+        if want <= set(events):
+            break
+        time.sleep(0.02)
+    assert want <= set(events), events
+    # the merged informer cache now serves reads for the synced kind
+    for ns in ns_by_shard.values():
+        assert router.get("Pod", "p0", ns)["metadata"]["namespace"] == ns
+    stop.set()
+
+
+def test_router_retries_through_shard_restart(stack):
+    """Retry-with-remap: a write aimed at a restarting shard (refused
+    connections) retries until the shard is back at its ring position,
+    instead of surfacing a transport error to the controller."""
+    router = ShardedKubeAPIServer(stack.urls, retry_window_s=10.0)
+    ns = "restart-ns"
+    victim = router.shard_of("Pod", None, ns)
+    router.ensure_namespace(ns)
+
+    old_port = int(stack.urls[victim].rsplit(":", 1)[1])
+    stack.rests[victim].stop()
+
+    def revive():
+        time.sleep(0.5)
+        # same store, same port: the shard "rebooted"
+        rest = RestServer(stack.apis[victim], port=old_port)
+        rest.start()
+        stack.rests[victim] = rest
+
+    reviver = threading.Thread(target=revive, daemon=True)
+    reviver.start()
+    out = router.create(_pod("p0", ns))  # must block-and-retry, not fail
+    assert out["metadata"]["name"] == "p0"
+    reviver.join()
+    assert stack.apis[victim].try_get("Pod", "p0", ns) is not None
+
+
+def test_router_retried_create_absorbs_lost_reply_conflict(stack):
+    """At-least-once chaos case: the shard COMMITS a create to its WAL
+    and dies before replying. The router's retry then hits
+    AlreadyExists — which must resolve to the committed object, not
+    surface as a conflict the storm never caused."""
+    router = ShardedKubeAPIServer(stack.urls, retry_window_s=10.0)
+    ns = "lost-reply-ns"
+    victim = router.shard_of("Pod", None, ns)
+    router.ensure_namespace(ns)
+    # the "commit" whose reply was lost in the crash
+    stack.apis[victim].create(_pod("p0", ns))
+
+    old_port = int(stack.urls[victim].rsplit(":", 1)[1])
+    stack.rests[victim].stop()
+
+    def revive():
+        time.sleep(0.4)
+        rest = RestServer(stack.apis[victim], port=old_port)
+        rest.start()
+        stack.rests[victim] = rest
+
+    threading.Thread(target=revive, daemon=True).start()
+    out = router.create(_pod("p0", ns))  # retries, then conflicts
+    assert out["metadata"]["name"] == "p0"
+
+    # but a FIRST-attempt conflict (no transport retry) stays an error
+    from kubeflow_rm_tpu.controlplane.apiserver import AlreadyExists
+    with pytest.raises(AlreadyExists):
+        router.create(_pod("p0", ns))
+
+
+def test_router_errors_are_not_retried_as_transient(stack):
+    router = ShardedKubeAPIServer(stack.urls)
+    t0 = time.monotonic()
+    with pytest.raises(NotFound):
+        router.get("Pod", "nope", "empty-ns")
+    assert time.monotonic() - t0 < 2.0  # no retry-window stall

@@ -1,8 +1,7 @@
 #!/usr/bin/env python3
 """HTTP serving throughput + speculative-decode workload bench.
 
-Two campaigns, each printing one JSON line (appended to
-``BENCH_SWEEP_r05_raw.jsonl`` by the caller):
+Four campaigns, each printing one JSON line:
 
 - ``serve``: boot ``examples/serve_llama.py``'s app in-process on a
   synthetic-weight model (``--preset`` / ``--quant``), fire N requests
@@ -16,6 +15,18 @@ Two campaigns, each printing one JSON line (appended to
   The model is trained briefly on a tiny repetitive corpus so greedy
   continuations actually repeat (random weights accept nothing —
   that's r4's measured worst case, not the win case).
+- ``decode``: the int4 decode-path A/B behind the unpack-once fix —
+  per-token-loop vs fused-with-hoist vs fused-re-unpack (the pre-fix
+  trace, restored via ``set_unpack_once(False)``) on one host, ms/tok
+  each. Feeds ``SERVE_r01.json`` ``decode_int4``.
+- ``storm``: the many-tenant serving storm — a mixed-length,
+  mixed-budget request schedule from T victim tenants plus one
+  flooding tenant, replayed against three same-host arms
+  (continuous batching + admission control, continuous without
+  admission, and serve_llama's static batcher), reporting per-tenant
+  p50/p95, aggregate USEFUL tokens/sec (tokens a request asked for —
+  the static arm decodes its server-fixed budget regardless), batch
+  occupancy, queue depth, and shed counts. Feeds ``SERVE_r01.json``.
 """
 
 from __future__ import annotations
@@ -189,22 +200,371 @@ def spec_campaign(preset: str, train_steps: int, max_new: int) -> dict:
     }
 
 
+def _device_tag() -> str:
+    import os
+
+    import jax
+    plat = jax.devices()[0].platform
+    if plat == "cpu":
+        return f"cpu-{os.cpu_count()}core"
+    return f"{plat}x{len(jax.devices())}"
+
+
+def decode_campaign(preset: str, batch: int, prompt_len: int,
+                    max_new: int, overrides: dict) -> dict:
+    """Int4 decode-path A/B: per-token loop vs fused-with-hoist vs
+    fused re-unpacking inside the scan (the pre-fix trace, restored
+    via ``set_unpack_once(False)``). All three arms decode the SAME
+    prompts greedily on the same host; the fused arms must also agree
+    token-for-token with the loop (exactness is part of the claim)."""
+    import jax
+    import numpy as np
+
+    from kubeflow_rm_tpu.models import LlamaConfig, generate_fused
+    from kubeflow_rm_tpu.models.generate import generate, set_unpack_once
+    from kubeflow_rm_tpu.models.quantize import init_params_quantized
+
+    cfg = getattr(LlamaConfig, preset)(**overrides)
+    params = init_params_quantized(cfg, jax.random.key(0), bits=4)
+    rng = np.random.default_rng(0)
+    ids = jax.numpy.asarray(
+        rng.integers(1, cfg.vocab_size, size=(batch, prompt_len)),
+        jax.numpy.int32)
+    total = prompt_len + max_new
+
+    def timed(fn, reps: int = 3):
+        out = fn()                       # compile + warm
+        jax.device_get(np.asarray(out)[:, -1])
+        ts = []
+        for _ in range(reps):            # median: CPU hosts are noisy
+            t0 = time.perf_counter()
+            out = fn()
+            jax.device_get(np.asarray(out)[:, -1])
+            ts.append(time.perf_counter() - t0)
+        return np.asarray(out), sorted(ts)[len(ts) // 2]
+
+    loop, t_loop = timed(lambda: generate(
+        params, cfg, ids, max_new_tokens=max_new, max_len=total))
+    set_unpack_once(True)
+    fused, t_fused = timed(lambda: generate_fused(
+        params, cfg, ids, max_new_tokens=max_new, max_len=total))
+    set_unpack_once(False)               # pre-fix arm: unpack per step
+    refused, t_reunpack = timed(lambda: generate_fused(
+        params, cfg, ids, max_new_tokens=max_new, max_len=total))
+    set_unpack_once(True)
+    return {
+        "metric": "decode_int4",
+        "model": f"llama-{preset} int4"
+                 + (f" {overrides}" if overrides else ""),
+        "device": _device_tag(),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": max_new,
+        "loop_ms_per_tok": round(1e3 * t_loop / max_new, 2),
+        "fused_ms_per_tok": round(1e3 * t_fused / max_new, 2),
+        "fused_reunpack_ms_per_tok": round(1e3 * t_reunpack / max_new, 2),
+        "fused_le_loop": bool(t_fused <= t_loop),
+        "outputs_match": bool((loop == fused).all()
+                              and (loop == refused).all()),
+    }
+
+
+def storm_campaign(preset: str, quant: str | None, tenants: int,
+                   reqs_per_tenant: int, flood_threads: int,
+                   flood_reqs: int, slots: int, slot_len: int,
+                   slo_ms: float, qps: float, burst: int,
+                   overrides: dict | None = None) -> dict:
+    """Many-tenant serving storm over three same-host arms sharing one
+    set of weights:
+
+    - ``continuous_admission``: ContinuousBatchingEngine behind
+      ServingGateway with per-tenant rate/token buckets + SLO shedding.
+    - ``continuous_no_admission``: same engine, ``admission=False``
+      (only the queue cap survives) — the noisy-neighbor baseline.
+    - ``static``: serve_llama's window-coalescing fixed-shape batcher,
+      which decodes its server-fixed budget for every request.
+
+    T victim tenants each send a mixed-length, mixed-budget schedule
+    at a polite rate; one flood tenant hammers from ``flood_threads``
+    parallel connections. Useful tokens = the ``max_new`` each request
+    ASKED for (the static arm decodes its fixed budget regardless, so
+    its extra tokens are waste, not throughput)."""
+    import logging
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+    from werkzeug.serving import make_server
+
+    # one log line per request x hundreds of storm requests = noise
+    logging.getLogger("werkzeug").setLevel(logging.ERROR)
+
+    from examples.serve_llama import make_app
+    from kubeflow_rm_tpu.controlplane.webapps.serving import (
+        ServingGateway, TenantPolicy, make_serving_app,
+    )
+    from kubeflow_rm_tpu.models import (
+        ContinuousBatchingEngine, LlamaConfig, init_params,
+    )
+
+    cfg = getattr(LlamaConfig, preset)(**(overrides or {}))
+    if quant:
+        from kubeflow_rm_tpu.models.quantize import init_params_quantized
+        params = init_params_quantized(cfg, jax.random.key(0),
+                                       bits=4 if quant == "int4" else 8)
+    else:
+        params = init_params(cfg, jax.random.key(0))
+
+    # Long-tail budgets: the static server must fix max_new at the tail
+    # (32) and decode it for EVERY request; the engine retires each
+    # request at its own ask.  avg ask ~= 14.7 vs 32 decoded is the
+    # over-decode waste the continuous arm gets back.
+    budgets = (4, 8, 32)
+    max_budget = max(budgets)
+    rng = np.random.default_rng(7)
+    # (tenant, prompt, max_new, gap_s) — victims pace themselves,
+    # the flood tenant does not
+    schedule: dict[str, list] = {}
+    for t in range(tenants):
+        name = f"tenant-{t}"
+        schedule[name] = [
+            (rng.integers(1, cfg.vocab_size,
+                          size=int(rng.integers(8, 49))).tolist(),
+             int(budgets[rng.integers(0, len(budgets))]),
+             0.02)
+            for _ in range(reqs_per_tenant)]
+    # the flood is mixed-length/mixed-budget too — a noisy tenant is
+    # ordinary traffic at extraordinary volume
+    flood_work = [
+        (rng.integers(1, cfg.vocab_size,
+                      size=int(rng.integers(8, 49))).tolist(),
+         int(budgets[rng.integers(0, len(budgets))]))
+        for _ in range(flood_reqs)]
+
+    def run_storm(url: str) -> tuple[list[dict], float]:
+        results: list[dict] = []
+        lock = threading.Lock()
+
+        def call(tenant, prompt, m):
+            body = {"prompt": prompt, "tenant": tenant,
+                    "max_new_tokens": m}
+            t0 = time.perf_counter()
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Tenant": tenant})
+            try:
+                resp = json.loads(
+                    urllib.request.urlopen(req, timeout=600).read())
+                ok, reason = True, None
+                # gateway arms return the continuation, the static arm
+                # prompt+continuation — both non-empty on success
+                assert resp["tokens"], resp
+            except urllib.error.HTTPError as e:
+                ok = False
+                try:
+                    reason = json.loads(e.read()).get("reason", str(e.code))
+                except Exception:
+                    reason = str(e.code)
+            lat = time.perf_counter() - t0
+            with lock:
+                results.append({"tenant": tenant, "ok": ok,
+                                "reason": reason, "useful": m if ok else 0,
+                                "lat_ms": lat * 1e3})
+
+        def victim(name):
+            for prompt, m, gap in schedule[name]:
+                call(name, prompt, m)
+                time.sleep(gap)
+
+        def flooder(i):
+            for j in range(i, len(flood_work), flood_threads):
+                call("flood", *flood_work[j])
+
+        ts = ([threading.Thread(target=victim, args=(n,))
+               for n in schedule]
+              + [threading.Thread(target=flooder, args=(i,))
+                 for i in range(flood_threads)])
+        t0 = time.perf_counter()
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        return results, time.perf_counter() - t0
+
+    def summarize(results, wall, extra) -> dict:
+        def pct(v, q):
+            return round(v[min(len(v) - 1, int(q * (len(v) - 1)))], 1)
+
+        per_tenant = {}
+        for name in sorted({r["tenant"] for r in results}):
+            lats = sorted(r["lat_ms"] for r in results
+                          if r["tenant"] == name and r["ok"])
+            per_tenant[name] = {
+                "ok": len(lats),
+                "shed": sum(1 for r in results
+                            if r["tenant"] == name and not r["ok"]),
+                "p50_ms": pct(lats, 0.50) if lats else None,
+                "p95_ms": pct(lats, 0.95) if lats else None,
+            }
+        victim_p95 = [v["p95_ms"] for k, v in per_tenant.items()
+                      if k != "flood" and v["p95_ms"] is not None]
+        return {
+            "wall_s": round(wall, 2),
+            "ok": sum(1 for r in results if r["ok"]),
+            "shed": sum(1 for r in results if not r["ok"]),
+            "useful_tokens": sum(r["useful"] for r in results),
+            "useful_tok_per_s": round(
+                sum(r["useful"] for r in results) / wall, 1),
+            "victim_p95_ms_worst": max(victim_p95) if victim_p95 else None,
+            "per_tenant": per_tenant,
+            **extra,
+        }
+
+    def continuous_arm(admission: bool) -> dict:
+        engine = ContinuousBatchingEngine(params, cfg, slots=slots,
+                                          slot_len=slot_len)
+        gw = ServingGateway(
+            engine,
+            default_policy=TenantPolicy(qps=qps, burst=burst,
+                                        tokens_per_s=qps * 16,
+                                        token_burst=burst * 16,
+                                        slo_p95_ms=slo_ms),
+            max_queue=64, admission=admission)
+        app = make_serving_app(gw, cfg)
+        httpd = make_server("127.0.0.1", 0, app, threaded=True)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_port}/generate"
+        # warm every prefill bucket (8/16/32/64) + decode/install
+        for n in (8, 12, 32, 48):
+            warm = urllib.request.Request(
+                url, data=json.dumps(
+                    {"prompt": list(range(1, n + 1)), "tenant": "warm",
+                     "max_new_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(warm, timeout=600).read()
+        results, wall = run_storm(url)
+        snap = gw.snapshot()
+        httpd.shutdown()
+        gw.close()
+        return summarize(results, wall, {
+            "admission": admission,
+            "batch_occupancy": round(snap["batch_occupancy"], 3),
+            "decode_steps": snap["decode_steps"],
+            "shed_reasons": snap["shed"],
+        })
+
+    def static_arm() -> dict:
+        app = make_app(cfg, params, max_new_tokens=max_budget,
+                       window_ms=8, max_batch=slots)
+        httpd = make_server("127.0.0.1", 0, app, threaded=True)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_port}/generate"
+        # warm the static batcher's (B, T) compile grid: waves at
+        # several concurrencies so the storm doesn't pay XLA compiles
+        def warm_one(n):
+            urllib.request.urlopen(urllib.request.Request(
+                url, data=json.dumps(
+                    {"prompt": list(range(1, n + 1))}).encode(),
+                headers={"Content-Type": "application/json"}),
+                timeout=600).read()
+
+        for wave in ((48,), (8, 40), (8, 16, 24, 48),
+                     (8, 16, 24, 32, 40, 48, 12, 20)):
+            warm_ts = [threading.Thread(target=warm_one, args=(n,))
+                       for n in wave]
+            for t in warm_ts:
+                t.start()
+            for t in warm_ts:
+                t.join()
+        results, wall = run_storm(url)
+        batches = app.batcher.batches_run
+        httpd.shutdown()
+        app.batcher.close()
+        return summarize(results, wall, {
+            "fixed_max_new": max_budget, "batches": batches})
+
+    return {
+        "metric": "serving_storm",
+        "model": f"llama-{preset}" + (f" {quant}" if quant else " bf16")
+                 + (f" {overrides}" if overrides else ""),
+        "device": _device_tag(),
+        "workload": {
+            "victim_tenants": tenants,
+            "reqs_per_tenant": reqs_per_tenant,
+            "flood_threads": flood_threads,
+            "flood_reqs": flood_reqs,
+            "budgets": list(budgets),
+            "slots": slots, "slot_len": slot_len,
+            "slo_p95_ms": slo_ms,
+        },
+        "arms": {
+            "continuous_admission": continuous_arm(True),
+            "continuous_no_admission": continuous_arm(False),
+            "static": static_arm(),
+        },
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("campaign", choices=["serve", "spec"])
+    ap.add_argument("campaign", choices=["serve", "spec", "decode",
+                                         "storm"])
     ap.add_argument("--preset", default="bench_1b")
     ap.add_argument("--quant", choices=["int8", "int4"], default=None)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--max-new", type=int, default=64)
     ap.add_argument("--train-steps", type=int, default=60)
+    # decode campaign: measurement shape + host-sized config overrides
+    # (recorded in the output — a CPU host can't time 7B honestly)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=None)
+    ap.add_argument("--layers", type=int, default=None)
+    ap.add_argument("--hidden", type=int, default=None)
+    ap.add_argument("--seq-len", type=int, default=None)
+    # storm campaign knobs
+    ap.add_argument("--tenants", type=int, default=6)
+    ap.add_argument("--reqs-per-tenant", type=int, default=8)
+    ap.add_argument("--flood-threads", type=int, default=12)
+    ap.add_argument("--flood-reqs", type=int, default=72)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--slot-len", type=int, default=128)
+    ap.add_argument("--slo-ms", type=float, default=2000.0)
+    ap.add_argument("--qps", type=float, default=25.0,
+                    help="per-tenant admitted request rate (storm)")
+    ap.add_argument("--burst", type=int, default=30)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON to this path")
     args = ap.parse_args()
     if args.campaign == "serve":
         out = serve_campaign(args.preset, args.quant, args.requests,
                              args.concurrency, args.max_new)
-    else:
+    elif args.campaign == "spec":
         out = spec_campaign(args.preset, args.train_steps, args.max_new)
+    elif args.campaign == "decode":
+        overrides = {k: v for k, v in {
+            "dim": args.dim, "n_layers": args.layers,
+            "hidden_dim": args.hidden,
+            "max_seq_len": args.seq_len}.items() if v is not None}
+        out = decode_campaign(args.preset, args.batch, args.prompt_len,
+                              args.max_new, overrides)
+    else:
+        overrides = {k: v for k, v in {
+            "dim": args.dim, "n_layers": args.layers,
+            "hidden_dim": args.hidden,
+            "max_seq_len": args.seq_len}.items() if v is not None}
+        out = storm_campaign(args.preset, args.quant, args.tenants,
+                             args.reqs_per_tenant, args.flood_threads,
+                             args.flood_reqs, args.slots, args.slot_len,
+                             args.slo_ms, args.qps, args.burst,
+                             overrides)
     print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
     return 0
 
 

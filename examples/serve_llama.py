@@ -208,21 +208,21 @@ class Batcher:
 def make_app(cfg, params, *, max_new_tokens: int = 64, mesh=None,
              window_ms: float = 5.0, max_batch: int = 8,
              speculative: bool = False, tokenizer=None,
-             fused_int4: bool = False):
+             fused_int4: bool = True):
     """werkzeug WSGI app + its Batcher. ``mesh`` switches the backend
     to the sharded ``make_generate_step`` program; ``speculative``
     routes solo greedy requests through the single-program
     prompt-lookup decoder (repetitive text decodes in fewer model
     passes; see ``generate_speculative_fused``).
 
-    int4 weights default to the per-token ``generate`` loop, NOT the
-    fused program: the fused scan re-unpacks every nibble-packed
-    weight on every one of its max_new_tokens steps inside one XLA
-    program, and at batch 8 on 7B that costs 612.77 ms/token vs the
-    loop's 137.07 (``BENCH_SWEEP_r05.json`` ``decode_7b`` — int4 is a
-    capacity lever, not a speed one). ``fused_int4=True`` opts back
-    into the fused program anyway (e.g. behind a network tunnel where
-    ~10 ms/token of per-step dispatch dominates)."""
+    int4 weights take the fused program by DEFAULT: the fused decode
+    loop now unpacks nibbles once per generation instead of once per
+    step (``quantize.unpack_int4_params``, hoisted ahead of the scan),
+    which removed the 612.77-vs-137.07 ms/tok regression that made PR 4
+    route int4 to the per-token loop (``BENCH_SWEEP_r05.json``
+    ``decode_7b``; re-measured in ``SERVE_r01.json`` ``decode_int4``).
+    ``fused_int4=False`` (``--loop-int4``) keeps the per-token loop as
+    the measured A/B baseline arm."""
     import jax
     import numpy as np
     from werkzeug.exceptions import BadRequest, HTTPException
@@ -381,13 +381,13 @@ def main(argv=None) -> int:
                          "(repetitive text decodes in fewer model "
                          "passes; one compile per distinct prompt "
                          "length)")
-    ap.add_argument("--fused-int4", action="store_true",
-                    help="force the fused decode program on int4 "
-                         "weights (default: int4 serves via the "
-                         "per-token loop — the fused scan's nibble "
-                         "re-unpack costs 612.77 ms/tok vs the loop's "
-                         "137.07 at 7B b8, BENCH_SWEEP_r05.json "
-                         "decode_7b)")
+    ap.add_argument("--loop-int4", action="store_true",
+                    help="serve int4 weights via the per-token "
+                         "generate loop instead of the fused program "
+                         "(A/B baseline arm; fused is the default now "
+                         "that the nibble unpack is hoisted out of "
+                         "the decode scan — SERVE_r01.json "
+                         "decode_int4)")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--fsdp", type=int, default=0,
                     help="0 = all local devices (with --tp 1 ⇒ "
@@ -436,7 +436,7 @@ def main(argv=None) -> int:
     app = make_app(cfg, params, max_new_tokens=args.max_new_tokens,
                    mesh=mesh, max_batch=args.max_batch,
                    speculative=args.speculative, tokenizer=tokenizer,
-                   fused_int4=args.fused_int4)
+                   fused_int4=not args.loop_int4)
 
     if args.selftest:
         from werkzeug.test import Client

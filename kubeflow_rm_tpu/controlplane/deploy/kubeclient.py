@@ -154,6 +154,24 @@ class TokenBucket:
             self._sleep(wait)
         return wait
 
+    def try_acquire(self, n: float = 1.0) -> bool:
+        """Non-blocking ``acquire``: debit ``n`` tokens iff the bucket
+        covers them right now, else leave the bucket untouched and
+        return False. The admission-control primitive — a serving
+        gateway sheds an over-limit request immediately (the client
+        retries with backoff) rather than queueing it into its own
+        latency SLO the way the blocking ``acquire`` would."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(float(self.burst),
+                               self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens < n:
+                self.throttled_calls += 1
+                return False
+            self._tokens -= n
+            return True
+
 
 class _Resp:
     """Minimal response shim over ``http.client.HTTPResponse`` with the

@@ -320,6 +320,58 @@ SHARD_RING_MEMBERS = Gauge(
     registry=REGISTRY,
 )
 
+# ---- serving gateway: continuous batching + tenant SLO enforcement --
+SERVING_QUEUE_DEPTH = Gauge(
+    "serving_queue_depth",
+    "Requests admitted by the gateway but not yet holding a decode "
+    "slot (the engine's internal admission queue)",
+    registry=REGISTRY,
+)
+SERVING_ACTIVE_SLOTS = Gauge(
+    "serving_active_slots",
+    "Decode slots currently mid-generation in the continuous-batching "
+    "engine (capacity is serving_slot_capacity)",
+    registry=REGISTRY,
+)
+SERVING_SLOT_CAPACITY = Gauge(
+    "serving_slot_capacity",
+    "Total decode slots in the engine's KV pool",
+    registry=REGISTRY,
+)
+SERVING_BATCH_OCCUPANCY = Gauge(
+    "serving_batch_occupancy",
+    "Mean fraction of decode slots doing useful work per decode step "
+    "since boot — the utilization win continuous batching exists for",
+    registry=REGISTRY,
+)
+SERVING_REQUESTS_TOTAL = Counter(
+    "serving_requests_total",
+    "Gateway requests by tenant and result (ok | shed | error)",
+    ["tenant", "result"],
+    registry=REGISTRY,
+)
+SERVING_SHED_TOTAL = Counter(
+    "serving_shed_total",
+    "Requests shed before touching the engine, by tenant and reason "
+    "(rate | tokens | queue | slo)",
+    ["tenant", "reason"],
+    registry=REGISTRY,
+)
+SERVING_REQUEST_LATENCY_SECONDS = Histogram(
+    "serving_request_latency_seconds",
+    "End-to-end request latency (admission to last token) per tenant",
+    ["tenant"],
+    buckets=(0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0),
+    registry=REGISTRY,
+)
+SERVING_GENERATED_TOKENS_TOTAL = Counter(
+    "serving_generated_tokens_total",
+    "Tokens decoded and returned, per tenant (the token-budget meter)",
+    ["tenant"],
+    registry=REGISTRY,
+)
+
 # the shard identity this process reports under — "" outside sharded
 # deployments so single-process metrics stay label-stable
 _SHARD = ""

@@ -209,6 +209,32 @@ def _controlplane_section(api=None) -> dict:
             "wake_to_observe_s": cp_metrics.registry_value(
                 "readiness_wake_to_observe_seconds_sum"),
         },
+        # continuous-batching serving gateway: slot utilization, queue
+        # pressure, and SLO enforcement (per-tenant split lives in the
+        # labelled /metrics exposition)
+        "serving": {
+            "queue_depth": cp_metrics.registry_value(
+                "serving_queue_depth"),
+            "active_slots": cp_metrics.registry_value(
+                "serving_active_slots"),
+            "slot_capacity": cp_metrics.registry_value(
+                "serving_slot_capacity"),
+            "batch_occupancy": cp_metrics.registry_value(
+                "serving_batch_occupancy"),
+            "requests_ok": cp_metrics.registry_value(
+                "serving_requests_total", {"result": "ok"}),
+            "requests_shed": cp_metrics.registry_value(
+                "serving_requests_total", {"result": "shed"}),
+            "shed": cp_metrics.registry_value("serving_shed_total"),
+            "generated_tokens": cp_metrics.registry_value(
+                "serving_generated_tokens_total"),
+            "request_latency": {
+                "count": cp_metrics.registry_value(
+                    "serving_request_latency_seconds_count"),
+                "seconds": cp_metrics.registry_value(
+                    "serving_request_latency_seconds_sum"),
+            },
+        },
     }
 
 
@@ -404,6 +430,24 @@ class PrometheusMetricsService:
                         "readiness_wake_to_observe_seconds_count"),
                     "wake_to_observe_s": g.get(
                         "readiness_wake_to_observe_seconds_sum"),
+                },
+                # tenant/result/reason labels summed by the flat scrape
+                "serving": {
+                    "queue_depth": g.get("serving_queue_depth"),
+                    "active_slots": g.get("serving_active_slots"),
+                    "slot_capacity": g.get("serving_slot_capacity"),
+                    "batch_occupancy": g.get("serving_batch_occupancy"),
+                    "requests_ok": None,
+                    "requests_shed": None,
+                    "shed": g.get("serving_shed_total"),
+                    "generated_tokens": g.get(
+                        "serving_generated_tokens_total"),
+                    "request_latency": {
+                        "count": g.get(
+                            "serving_request_latency_seconds_count"),
+                        "seconds": g.get(
+                            "serving_request_latency_seconds_sum"),
+                    },
                 },
             },
         }

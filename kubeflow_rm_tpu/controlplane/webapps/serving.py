@@ -1,0 +1,329 @@
+"""Multi-tenant serving gateway over the continuous-batching engine.
+
+The serving analogue of what the provision path grew in PRs 3-5: the
+decode engine (``models.generate.ContinuousBatchingEngine``) gives us
+slot-level admission/retirement at token boundaries; this module puts
+a tenant-aware front door on it so one tenant's storm cannot blow
+another's p95 — the failure mode ``benchmarks/serve_bench.py``'s
+static batches had no answer to.
+
+Admission control, in order (first failure sheds the request before it
+ever touches the engine):
+
+1. **Request rate** — per-tenant ``TokenBucket.try_acquire(1)``
+   (the same client-go-style bucket kubeclient throttles writes with,
+   non-blocking: an over-rate request is shed with 429 immediately
+   instead of queueing into everyone else's latency).
+2. **Token budget** — a second per-tenant bucket denominated in
+   TOKENS (``try_acquire(max_new_tokens)``): a tenant asking for long
+   generations spends its budget proportionally.
+3. **Queue cap** — a bounded engine queue; beyond it, 503.
+4. **p95 SLO projection** — shed (503) when the queue-depth-scaled
+   EMA of recent request service times projects past the configured
+   SLO: ``(queue/slots + 1) * ema_ms > slo_ms``. This is what keeps
+   ACCEPTED requests inside the SLO under overload: the gateway sheds
+   load instead of violating latency.
+
+Everything is observable: queue depth, batch occupancy, per-tenant
+request/shed counters and latency histograms land in the control-plane
+prometheus registry (``controlplane/metrics.py``), flow into the
+dashboard's ``/api/metrics`` controlplane section
+(``webapps/metrics_service._controlplane_section``), and are also
+served directly by this app's own ``/metrics`` + ``/api/metrics``
+routes — the serving pod is scrape-compatible with the rest of the
+platform.
+
+API: ``POST /generate {"prompt": [ids...], "tenant"?: "name",
+"max_new_tokens"?: n}`` → ``{"tokens": [ids...], "latency_ms": ...}``;
+``GET /healthz``; ``GET /metrics`` (prometheus text);
+``GET /api/metrics`` (the serving JSON section).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+
+from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+from kubeflow_rm_tpu.controlplane.deploy.kubeclient import TokenBucket
+
+
+@dataclass(frozen=True)
+class TenantPolicy:
+    """Per-tenant admission knobs. ``qps``/``burst`` bound request
+    RATE; ``tokens_per_s``/``token_burst`` bound decoded-token SPEND;
+    ``slo_p95_ms`` is the latency promise the gateway sheds to keep."""
+    qps: float = 20.0
+    burst: int = 40
+    tokens_per_s: float = 2000.0
+    token_burst: int = 4000
+    slo_p95_ms: float = 2000.0
+
+
+class _Pending:
+    """A request in flight: the HTTP thread parks on ``event`` while
+    the drain thread decodes."""
+
+    __slots__ = ("req", "tenant", "event", "t_submit", "t_done")
+
+    def __init__(self, req, tenant):
+        self.req = req
+        self.tenant = tenant
+        self.event = threading.Event()
+        self.t_submit = time.monotonic()
+        self.t_done = None
+
+
+class ServingGateway:
+    """Admission control + drain loop around one decode engine.
+
+    ``admission=False`` turns checks 1/2/4 off (the noisy-neighbor A/B
+    baseline arm: everything is admitted, victims eat the flood). The
+    queue cap stays on in both arms — an unbounded queue is an OOM,
+    not a policy choice.
+    """
+
+    def __init__(self, engine, *, policies: dict | None = None,
+                 default_policy: TenantPolicy | None = None,
+                 max_queue: int = 64, admission: bool = True,
+                 clock=None):
+        self.engine = engine
+        self.policies = dict(policies or {})
+        self.default_policy = default_policy or TenantPolicy()
+        self.max_queue = max_queue
+        self.admission = admission
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()        # engine + pending state
+        self._rate_buckets: dict[str, TokenBucket] = {}
+        self._token_buckets: dict[str, TokenBucket] = {}
+        self._pending: list[_Pending] = []
+        # sliding per-tenant latency windows for p95 reporting, plus
+        # the EMA the SLO projection sheds on
+        self._lat_windows: dict[str, list[float]] = {}
+        self._ema_ms: float | None = None
+        self.shed_counts: dict[str, int] = {}
+        self._stop = threading.Event()
+        cp_metrics.SERVING_SLOT_CAPACITY.set(engine.slots)
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    # -- policy plumbing ---------------------------------------------------
+
+    def _policy(self, tenant: str) -> TenantPolicy:
+        return self.policies.get(tenant, self.default_policy)
+
+    def _buckets(self, tenant: str) -> tuple[TokenBucket, TokenBucket]:
+        if tenant not in self._rate_buckets:
+            pol = self._policy(tenant)
+            self._rate_buckets[tenant] = TokenBucket(
+                pol.qps, pol.burst, clock=self._clock)
+            self._token_buckets[tenant] = TokenBucket(
+                pol.tokens_per_s, pol.token_burst, clock=self._clock)
+        return self._rate_buckets[tenant], self._token_buckets[tenant]
+
+    # -- admission ---------------------------------------------------------
+
+    def _shed(self, tenant: str, reason: str) -> None:
+        cp_metrics.SERVING_SHED_TOTAL.labels(tenant, reason).inc()
+        cp_metrics.SERVING_REQUESTS_TOTAL.labels(tenant, "shed").inc()
+        self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+
+    def try_submit(self, tenant: str, prompt: list[int], *,
+                   max_new_tokens: int,
+                   eos_id: int | None = None) -> tuple[_Pending | None,
+                                                       str | None]:
+        """Admit or shed. Returns (pending, None) on admit,
+        (None, reason) on shed — reason in rate|tokens|queue|slo."""
+        pol = self._policy(tenant)
+        if self.admission:
+            rate, budget = self._buckets(tenant)
+            if not rate.try_acquire(1.0):
+                self._shed(tenant, "rate")
+                return None, "rate"
+            if not budget.try_acquire(float(max_new_tokens)):
+                self._shed(tenant, "tokens")
+                return None, "tokens"
+        with self._lock:
+            depth = self.engine.queue_depth
+            if depth >= self.max_queue:
+                self._shed(tenant, "queue")
+                return None, "queue"
+            if self.admission and self._ema_ms is not None:
+                projected = (depth / self.engine.slots + 1.0) \
+                    * self._ema_ms
+                if projected > pol.slo_p95_ms:
+                    self._shed(tenant, "slo")
+                    return None, "slo"
+            req = self.engine.submit(prompt,
+                                     max_new_tokens=max_new_tokens,
+                                     eos_id=eos_id)
+            pending = _Pending(req, tenant)
+            self._pending.append(pending)
+            cp_metrics.SERVING_QUEUE_DEPTH.set(self.engine.queue_depth)
+        return pending, None
+
+    def wait(self, pending: _Pending, timeout_s: float = 300.0
+             ) -> list[int]:
+        if not pending.event.wait(timeout_s):
+            raise TimeoutError("generation timed out")
+        lat_s = pending.t_done - pending.t_submit
+        tenant = pending.tenant
+        cp_metrics.SERVING_REQUESTS_TOTAL.labels(tenant, "ok").inc()
+        cp_metrics.SERVING_REQUEST_LATENCY_SECONDS.labels(
+            tenant).observe(lat_s)
+        cp_metrics.SERVING_GENERATED_TOKENS_TOTAL.labels(tenant).inc(
+            len(pending.req.tokens))
+        return pending.req.tokens
+
+    # -- drain loop --------------------------------------------------------
+
+    def _drain(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                busy = (self.engine.queue_depth
+                        or self.engine.active_slots)
+                finished = self.engine.step() if busy else []
+                if busy:
+                    stats = self.engine.stats()
+                    cp_metrics.SERVING_QUEUE_DEPTH.set(
+                        stats["queue_depth"])
+                    cp_metrics.SERVING_ACTIVE_SLOTS.set(
+                        stats["active_slots"])
+                    cp_metrics.SERVING_BATCH_OCCUPANCY.set(
+                        stats["batch_occupancy"])
+                if finished:
+                    done_ids = {id(p.req) for p in self._pending
+                                if p.req.done}
+                    now = time.monotonic()
+                    ready = [p for p in self._pending
+                             if id(p.req) in done_ids]
+                    self._pending = [p for p in self._pending
+                                     if id(p.req) not in done_ids]
+                else:
+                    ready = []
+            for p in ready:
+                p.t_done = now
+                lat_ms = (p.t_done - p.t_submit) * 1e3
+                window = self._lat_windows.setdefault(p.tenant, [])
+                window.append(lat_ms)
+                del window[:-256]
+                self._ema_ms = (lat_ms if self._ema_ms is None else
+                                0.8 * self._ema_ms + 0.2 * lat_ms)
+                p.event.set()
+            if not busy:
+                self._stop.wait(0.001)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        for p in self._pending:   # fail any orphans
+            p.t_done = time.monotonic()
+            p.event.set()
+
+    # -- observability -----------------------------------------------------
+
+    def tenant_latency(self, tenant: str) -> dict:
+        window = sorted(self._lat_windows.get(tenant, []))
+        if not window:
+            return {"count": 0, "p50_ms": None, "p95_ms": None}
+        return {
+            "count": len(window),
+            "p50_ms": window[int(0.50 * (len(window) - 1))],
+            "p95_ms": window[int(0.95 * (len(window) - 1))],
+        }
+
+    def snapshot(self) -> dict:
+        stats = self.engine.stats()
+        return {
+            "admission": self.admission,
+            "queue_depth": stats["queue_depth"],
+            "active_slots": stats["active_slots"],
+            "slot_capacity": stats["slots"],
+            "batch_occupancy": stats["batch_occupancy"],
+            "decode_steps": stats["decode_steps"],
+            "finished_total": stats["finished_total"],
+            "shed": dict(self.shed_counts),
+            "ema_service_ms": self._ema_ms,
+            "tenants": {t: self.tenant_latency(t)
+                        for t in sorted(self._lat_windows)},
+        }
+
+
+def make_serving_app(gateway: ServingGateway, cfg):
+    """werkzeug WSGI app over a gateway: the tenant-facing front door.
+
+    Requests carry a ``tenant`` field (header ``X-Tenant`` also
+    accepted — the auth companion injects it in-cluster); sheds map to
+    429 (per-tenant rate/budget — the client should back off) or 503
+    (gateway-wide queue/SLO pressure — retry against another replica).
+    """
+    from werkzeug.exceptions import BadRequest, HTTPException
+    from werkzeug.routing import Map, Rule
+    from werkzeug.wrappers import Request, Response
+
+    urls = Map([Rule("/generate", endpoint="generate", methods=["POST"]),
+                Rule("/healthz", endpoint="healthz"),
+                Rule("/metrics", endpoint="metrics"),
+                Rule("/api/metrics", endpoint="api_metrics")])
+
+    def _json(payload, status=200):
+        return Response(json.dumps(payload), status=status,
+                        content_type="application/json")
+
+    def app(environ, start_response):
+        req = Request(environ)
+        try:
+            endpoint, _ = urls.bind_to_environ(environ).match()
+            if endpoint == "healthz":
+                return _json({"ok": True})(environ, start_response)
+            if endpoint == "metrics":
+                resp = Response(cp_metrics.scrape(),
+                                content_type="text/plain; version=0.0.4")
+                return resp(environ, start_response)
+            if endpoint == "api_metrics":
+                return _json({"serving": gateway.snapshot()})(
+                    environ, start_response)
+            body = req.get_json(force=True)
+            if not isinstance(body, dict):
+                raise BadRequest("body must be a JSON object")
+            prompt = body.get("prompt")
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int)
+                               and 0 <= t < cfg.vocab_size
+                               for t in prompt)):
+                raise BadRequest("prompt must be a non-empty list of "
+                                 f"token ids in [0, {cfg.vocab_size})")
+            tenant = body.get("tenant") \
+                or req.headers.get("X-Tenant") or "default"
+            if not isinstance(tenant, str) or len(tenant) > 64:
+                raise BadRequest("tenant must be a short string")
+            max_new = body.get("max_new_tokens", 16)
+            if not isinstance(max_new, int) or not 1 <= max_new <= 4096:
+                raise BadRequest("max_new_tokens must be an int in "
+                                 "[1, 4096]")
+            eos_id = body.get("eos_id")
+            if eos_id is not None and not isinstance(eos_id, int):
+                raise BadRequest("eos_id must be an int")
+            try:
+                pending, reason = gateway.try_submit(
+                    tenant, prompt, max_new_tokens=max_new,
+                    eos_id=eos_id)
+            except ValueError as e:   # request cannot fit a slot
+                raise BadRequest(str(e)) from e
+            if pending is None:
+                status = 429 if reason in ("rate", "tokens") else 503
+                resp = _json({"error": "shed", "reason": reason},
+                             status=status)
+                resp.headers["Retry-After"] = "1"
+                return resp(environ, start_response)
+            tokens = gateway.wait(pending)
+            lat_ms = (pending.t_done - pending.t_submit) * 1e3
+            resp = _json({"tokens": tokens, "latency_ms": lat_ms})
+        except HTTPException as e:
+            resp = e
+        return resp(environ, start_response)
+
+    app.gateway = gateway
+    return app

@@ -8,17 +8,26 @@ from kubeflow_rm_tpu.models import llama as _llama
 from kubeflow_rm_tpu.models import mixtral as _mixtral
 from kubeflow_rm_tpu.models.convert import config_from_hf, from_hf_llama
 from kubeflow_rm_tpu.models.lora import add_lora, lora_mask, merge_lora
-from kubeflow_rm_tpu.models.quantize import maybe_dequant, quantize_params
+from kubeflow_rm_tpu.models.quantize import (
+    maybe_dequant,
+    quantize_params,
+    unpack_int4_params,
+)
 from kubeflow_rm_tpu.models.generate import (
+    ContinuousBatchingEngine,
+    EngineRequest,
     KVCache,
+    SlotCache,
     cache_shardings,
     decode_chunk,
     generate,
     generate_fused,
     generate_speculative_fused,
     init_cache,
+    init_slot_cache,
     make_decode_step,
     make_generate_step,
+    slot_decode_step,
 )
 from kubeflow_rm_tpu.models.llama import LlamaConfig, forward
 from kubeflow_rm_tpu.models.mixtral import MixtralConfig
@@ -41,10 +50,12 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
     return _llama.forward(params, tokens, cfg, **kwargs), None
 
 
-__all__ = ["KVCache", "LlamaConfig", "MixtralConfig", "add_lora",
+__all__ = ["ContinuousBatchingEngine", "EngineRequest", "KVCache",
+           "LlamaConfig", "MixtralConfig", "SlotCache", "add_lora",
            "config_from_hf",
            "cache_shardings", "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",
            "generate", "generate_fused", "generate_speculative_fused",
-           "init_cache", "init_params",
-           "make_decode_step", "make_generate_step",
-           "lora_mask", "maybe_dequant", "merge_lora", "quantize_params"]
+           "init_cache", "init_params", "init_slot_cache",
+           "make_decode_step", "make_generate_step", "slot_decode_step",
+           "lora_mask", "maybe_dequant", "merge_lora", "quantize_params",
+           "unpack_int4_params"]

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 
 from kubeflow_rm_tpu.models.llama import LlamaConfig
 from kubeflow_rm_tpu.models.lora import lora_proj
-from kubeflow_rm_tpu.models.quantize import maybe_dequant
+from kubeflow_rm_tpu.models.quantize import maybe_dequant, unpack_int4_params
 from kubeflow_rm_tpu.ops import (
     apply_rope,
     dot_product_attention,
@@ -84,20 +84,45 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
     unpadded (``tests/test_generate.py``).
     """
     B, Tc = tokens.shape
-    H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    cdt = cfg.dtype
 
     positions = cache.offset + jnp.arange(Tc, dtype=jnp.int32)
     positions = jnp.broadcast_to(positions, (B, Tc))
     if pad_counts is not None:
         positions = positions - pad_counts[:, None]
         positions = jnp.where(positions < 0, _UNFILLED, positions)
+    kv_positions = jax.lax.dynamic_update_slice(
+        cache.positions, positions, (0, cache.offset))
+
+    def write_kv(c, val):
+        return jax.lax.dynamic_update_slice(c, val, (0, cache.offset, 0, 0))
+
+    logits, new_k, new_v = _run_blocks(
+        params, cfg, cache.k, cache.v, tokens, positions, kv_positions,
+        write_kv)
+    new_cache = KVCache(k=new_k, v=new_v, positions=kv_positions,
+                       offset=cache.offset + Tc)
+    return logits, new_cache
+
+
+def _run_blocks(params, cfg, cache_k, cache_v, tokens, positions,
+                kv_positions, write_kv):
+    """Transformer trunk shared by the shared-offset ``decode_chunk``
+    and the per-slot-offset ``slot_decode_step``: embed, layer scan
+    (attention against the KV cache + FFN), final norm, lm head. The
+    two callers differ ONLY in how positions are assigned and how this
+    chunk's K/V lands in the cache (``write_kv``: contiguous
+    ``dynamic_update_slice`` at one shared offset vs a per-row scatter
+    at each slot's own offset) — the math here is identical, which is
+    what makes the continuous-batching engine bit-identical to
+    ``generate_fused``."""
+    B, Tc = tokens.shape
+    H, KVH, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    cdt = cfg.dtype
+
     # rope of a ~2^31 position is finite but wild; clamp pads to 0
     # (their K is masked out by the _UNFILLED position anyway)
     rope_pos = jnp.where(positions == _UNFILLED, 0, positions)
     cos, sin = rope_angles(rope_pos, hd, cfg.rope_theta)
-    kv_positions = jax.lax.dynamic_update_slice(
-        cache.positions, positions, (0, cache.offset))
 
     x = params["embed"]["tokens"][tokens].astype(cdt)
 
@@ -130,8 +155,8 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
         v = proj("wv", h).reshape(B, Tc, KVH, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache.offset, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache.offset, 0, 0))
+        ck = write_kv(ck, k)
+        cv = write_kv(cv, v)
         attn = dot_product_attention(
             q, ck, cv, causal=True,
             positions_q=positions, positions_kv=kv_positions,
@@ -141,13 +166,11 @@ def decode_chunk(params: dict, cfg: LlamaConfig, cache: KVCache,
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["blocks"], cache.k, cache.v))
+        body, x, (params["blocks"], cache_k, cache_v))
     x = rms_norm(x, params["out_norm"], cfg.norm_eps)
     logits = (x @ maybe_dequant(params["lm_head"], cdt)
               ).astype(jnp.float32)
-    new_cache = KVCache(k=new_k, v=new_v, positions=kv_positions,
-                       offset=cache.offset + Tc)
-    return logits, new_cache
+    return logits, new_k, new_v
 
 
 def cache_shardings(cfg: LlamaConfig, mesh) -> KVCache:
@@ -215,13 +238,45 @@ def _decode_step(params, cfg, cache, tokens, pad_counts=None):
     return decode_chunk(params, cfg, cache, tokens, pad_counts)
 
 
+#: Hoist the int4 nibble unpack out of the fused decode scan (the
+#: fix for fused int4 being 4.5x SLOWER than the per-token loop —
+#: 612.77 vs 137.07 ms/tok @B8 7B, BENCH_SWEEP_r05 decode_7b: the old
+#: trace re-unpacked every weight every step). False restores the
+#: in-scan-unpack arm for A/B measurement only.
+_UNPACK_ONCE = True
+
+
+def set_unpack_once(flag: bool) -> None:
+    """A/B toggle for the loop-invariant int4 unpack hoist (see
+    ``_UNPACK_ONCE``). Clears the fused-path jit caches — the flag is
+    read at trace time, so already-compiled programs would otherwise
+    keep whichever arm they were traced under."""
+    global _UNPACK_ONCE
+    _UNPACK_ONCE = bool(flag)
+    _fused_generate.clear_cache()
+    _fused_speculative.clear_cache()
+
+
+def _hoist_unpack(params):
+    """Unpack packed-int4 leaves once per trace (outside any scan over
+    decode steps) so every step reads loop-invariant int8 groups."""
+    return unpack_int4_params(params) if _UNPACK_ONCE else params
+
+
 def _fused_decode_loop(params, cfg, prompt, key, *, max_new_tokens,
                        temperature, top_k, eos_id, total_len,
                        cache_sharding=None, pad_counts=None):
     """Trace-time body shared by ``generate_fused`` (single device) and
     ``make_generate_step`` (sharded): prefill, then a ``lax.scan`` over
     decode steps. ``cache_sharding`` (a NamedSharding pytree) pins the
-    freshly-initialized cache's layout under GSPMD."""
+    freshly-initialized cache's layout under GSPMD.
+
+    Packed-int4 params are unpacked to int8 groups HERE — before the
+    scan, so the nibble unpack happens once per generation instead of
+    once per token (the per-step cost drops to the int8→bf16 dequant
+    prologue; dequant on the unpacked form is bit-identical to dequant
+    on the packed form, see ``quantize.unpack_int4``)."""
+    params = _hoist_unpack(params)
     B, _ = prompt.shape
     cache = init_cache(cfg, B, total_len)
     if cache_sharding is not None:
@@ -330,6 +385,7 @@ def _fused_speculative(params, prompt, *, cfg, max_new_tokens,
     Worst case (nothing accepts) each round still commits 1 token at
     chunk cost ≈ step cost; best case commits draft_k+1.
     """
+    params = _hoist_unpack(params)  # unpack int4 once, not per round
     Tp = prompt.shape[1]
     W = draft_k + 1
     S = total_len  # buffer/cache length, incl. chunk overhang room
@@ -550,3 +606,304 @@ def generate(params: dict, cfg: LlamaConfig, prompt: jax.Array, *,
                                          pad_counts)
             last = logits[:, -1, :]
     return jnp.concatenate(out, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching: fixed-capacity KV slots with PER-SLOT offsets.
+#
+# ``generate_fused`` runs a batch in lockstep — every row prefills
+# together, decodes together, and the whole batch's HBM reservation is
+# held until the LAST row finishes (a 4-token reply waits on a
+# 256-token neighbour, and no new request can start until everyone is
+# done). The engine below decouples rows: the cache is a pool of B
+# independent slots, each with its own write offset and next-position
+# counter, so requests are admitted into free slots and retired out of
+# them at token boundaries while the other slots keep decoding.
+# This is the serving-side analogue of what Orca-style continuous
+# batching does for GPU serving, built on the same position-masked
+# attention trick the ragged batcher uses: an inactive slot's query
+# position is _UNFILLED, so whatever garbage it writes that step is
+# invisible to every real query, and per-row output stays bit-identical
+# to a one-shot ``generate_fused`` call for that row alone
+# (``tests/test_generate.py``).
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SlotCache:
+    """KV pool for continuous batching: like ``KVCache`` but the write
+    offset and next token position are per-row vectors, so each slot
+    advances independently."""
+    k: jax.Array          # (L, B, S, KVH, hd) compute dtype
+    v: jax.Array          # (L, B, S, KVH, hd)
+    positions: jax.Array  # (B, S) int32; _UNFILLED marks empty slots
+    write_idx: jax.Array  # (B,) int32: next KV write slot per row
+    pos_next: jax.Array   # (B,) int32: next token position per row
+
+
+def init_slot_cache(cfg: LlamaConfig, slots: int,
+                    slot_len: int) -> SlotCache:
+    L, KVH, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    return SlotCache(
+        k=jnp.zeros((L, slots, slot_len, KVH, hd), cfg.dtype),
+        v=jnp.zeros((L, slots, slot_len, KVH, hd), cfg.dtype),
+        positions=jnp.full((slots, slot_len), _UNFILLED, jnp.int32),
+        write_idx=jnp.zeros((slots,), jnp.int32),
+        pos_next=jnp.zeros((slots,), jnp.int32),
+    )
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def _install_row(cache: SlotCache, row_cache: KVCache, row: jax.Array,
+                 n_real: jax.Array) -> SlotCache:
+    """Copy a freshly-prefilled single-request cache (B=1, same S) into
+    slot ``row`` of the pool. ``n_real`` is the request's REAL prompt
+    length (sans left-pad): the slot resumes at position n_real while
+    its writes continue at the padded offset — exactly where a fused
+    left-padded batch would put them."""
+    return SlotCache(
+        k=cache.k.at[:, row].set(row_cache.k[:, 0]),
+        v=cache.v.at[:, row].set(row_cache.v[:, 0]),
+        positions=cache.positions.at[row].set(row_cache.positions[0]),
+        write_idx=cache.write_idx.at[row].set(row_cache.offset),
+        pos_next=cache.pos_next.at[row].set(n_real),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def slot_decode_step(params, cfg, cache: SlotCache, tokens, active):
+    """One decode step over the whole slot pool.
+
+    ``tokens`` (B,) int32 is each slot's freshly-sampled token;
+    ``active`` (B,) bool masks live slots. Every row writes K/V at its
+    OWN ``write_idx`` (a batched scatter — the per-slot analogue of
+    ``decode_chunk``'s shared-offset ``dynamic_update_slice``) and
+    attends at its OWN ``pos_next``. Inactive rows still flow through
+    the matmuls (static shapes) but their query position is _UNFILLED
+    and their counters don't advance, so their writes are invisible
+    and harmless — the slot is fully re-initialized on the next admit.
+    Returns (last-position logits (B, V) fp32, updated cache).
+    """
+    B = tokens.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)
+    positions = jnp.where(active, cache.pos_next, _UNFILLED)[:, None]
+    kv_positions = cache.positions.at[rows, cache.write_idx].set(
+        positions[:, 0])
+
+    def write_kv(c, val):
+        # (B, S, KVH, hd) cache, (B, 1, KVH, hd) chunk: row i lands in
+        # its own slot at its own offset
+        return c.at[rows, cache.write_idx].set(val[:, 0])
+
+    logits, new_k, new_v = _run_blocks(
+        params, cfg, cache.k, cache.v, tokens[:, None], positions,
+        kv_positions, write_kv)
+    inc = active.astype(jnp.int32)
+    new_cache = SlotCache(k=new_k, v=new_v, positions=kv_positions,
+                          write_idx=cache.write_idx + inc,
+                          pos_next=cache.pos_next + inc)
+    return logits[:, -1, :], new_cache
+
+
+@partial(jax.jit, static_argnames=("temperature", "top_k"))
+def _pick_row(last, key, *, temperature, top_k):
+    """Jitted single-row ``_pick`` — the engine samples per slot (each
+    request has its own PRNG stream) but through the same sampling
+    source as both batch decode paths."""
+    return _pick(last[None, :], key, temperature=temperature,
+                 top_k=top_k)[0]
+
+
+def _bucket_len(n: int) -> int:
+    """Next power of two ≥ n: the prefill padding buckets, so a storm
+    of ragged prompts compiles O(log) prefill programs instead of one
+    per distinct length (same policy as serve_llama's batcher)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class EngineRequest:
+    """Handle returned by ``ContinuousBatchingEngine.submit``:
+    ``tokens`` fills in as the request decodes; ``done`` flips when the
+    slot retires (eos or max_new_tokens)."""
+
+    _next_id = 0
+
+    def __init__(self, prompt, *, max_new_tokens, eos_id, temperature,
+                 top_k, key):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.key = key
+        self.tokens: list[int] = []
+        self.done = False
+        self.rid = EngineRequest._next_id
+        EngineRequest._next_id += 1
+        # filled by the engine for latency accounting
+        self.submitted_step = None
+        self.admitted_step = None
+        self.finished_step = None
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous-batching decode engine.
+
+    ``submit`` queues a request; ``step`` admits queued requests into
+    free slots (one prefill each, via the shared ``_decode_step``),
+    runs ONE ``slot_decode_step`` for all live slots, samples each
+    slot's next token host-side, and retires slots that hit eos or
+    their token budget — so short requests leave (and new ones enter)
+    mid-flight instead of waiting for the longest neighbour.
+
+    Exactness contract: each request's output is bit-identical to
+    ``generate_fused(prompt[None], max_new_tokens=..., max_len=slot_len)``
+    for that request alone (greedy; sampled requests use their own key
+    stream). Packed-int4 params are unpacked ONCE at construction so
+    per-step cost is the int8→bf16 dequant prologue, same as the fixed
+    fused path.
+    """
+
+    def __init__(self, params, cfg, *, slots: int = 8,
+                 slot_len: int = 256):
+        self.cfg = cfg
+        self.slots = slots
+        self.slot_len = slot_len
+        # unpack int4 leaves once, outside any per-step work; no-op on
+        # int8/bf16 trees
+        self.params = jax.jit(unpack_int4_params)(params)
+        self.cache = init_slot_cache(cfg, slots, slot_len)
+        self._slot_req: list[EngineRequest | None] = [None] * slots
+        self._last = [None] * slots   # (V,) logits per live slot
+        self._queue: list[EngineRequest] = []
+        # counters surfaced by stats()
+        self.decode_steps = 0
+        self.prefills = 0
+        self.occupancy_sum = 0
+        self.admitted_total = 0
+        self.finished_total = 0
+
+    # -- request lifecycle -------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: int,
+               eos_id: int | None = None, temperature: float = 0.0,
+               top_k: int | None = None,
+               key: jax.Array | None = None) -> EngineRequest:
+        Tp = len(prompt)
+        if Tp == 0:
+            raise ValueError("empty prompt")
+        need = _bucket_len(Tp) + max_new_tokens
+        if need > self.slot_len:
+            raise ValueError(
+                f"request needs {need} cache slots (prefill bucket "
+                f"{_bucket_len(Tp)} + {max_new_tokens} new) > slot_len "
+                f"{self.slot_len}")
+        if temperature > 0 and key is None:
+            raise ValueError("sampling (temperature > 0) requires a key")
+        req = EngineRequest(prompt, max_new_tokens=max_new_tokens,
+                            eos_id=eos_id, temperature=temperature,
+                            top_k=top_k, key=key)
+        req.submitted_step = self.decode_steps
+        self._queue.append(req)
+        return req
+
+    def _admit(self) -> None:
+        for i in range(self.slots):
+            if not self._queue:
+                return
+            if self._slot_req[i] is not None:
+                continue
+            req = self._queue.pop(0)
+            Tp = len(req.prompt)
+            Tb = _bucket_len(Tp)
+            padded = jnp.asarray([[0] * (Tb - Tp) + req.prompt],
+                                 jnp.int32)
+            pads = jnp.asarray([Tb - Tp], jnp.int32)
+            tmp = init_cache(self.cfg, 1, self.slot_len)
+            logits, tmp = _decode_step(self.params, self.cfg, tmp,
+                                       padded, pads)
+            self.cache = _install_row(
+                self.cache, tmp, jnp.asarray(i, jnp.int32),
+                jnp.asarray(Tp, jnp.int32))
+            self._last[i] = logits[0, -1, :]
+            self._slot_req[i] = req
+            req.admitted_step = self.decode_steps
+            self.prefills += 1
+            self.admitted_total += 1
+
+    def step(self) -> list[EngineRequest]:
+        """Admit, sample, retire, decode — one token boundary. Returns
+        the requests that finished at this boundary."""
+        self._admit()
+        finished: list[EngineRequest] = []
+        tokens = [0] * self.slots
+        active = [False] * self.slots
+        for i, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            if req.temperature > 0:
+                req.key, sub = jax.random.split(req.key)
+            else:
+                sub = None
+            nxt = int(_pick_row(self._last[i], sub,
+                                temperature=req.temperature,
+                                top_k=req.top_k))
+            req.tokens.append(nxt)
+            hit_eos = req.eos_id is not None and nxt == req.eos_id
+            if hit_eos or len(req.tokens) >= req.max_new_tokens:
+                req.done = True
+                req.finished_step = self.decode_steps
+                finished.append(req)
+                self._slot_req[i] = None
+                self._last[i] = None
+                self.finished_total += 1
+            else:
+                tokens[i] = nxt
+                active[i] = True
+        n_active = sum(active)
+        if n_active:
+            last, self.cache = slot_decode_step(
+                self.params, self.cfg, self.cache,
+                jnp.asarray(tokens, jnp.int32), jnp.asarray(active))
+            for i in range(self.slots):
+                if active[i]:
+                    self._last[i] = last[i]
+            self.decode_steps += 1
+            self.occupancy_sum += n_active
+        return finished
+
+    def run(self) -> list[EngineRequest]:
+        """Drive ``step`` until every queued/live request retires."""
+        out: list[EngineRequest] = []
+        while self._queue or any(r is not None for r in self._slot_req):
+            out.extend(self.step())
+        return out
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    def stats(self) -> dict:
+        steps = self.decode_steps
+        return {
+            "slots": self.slots,
+            "slot_len": self.slot_len,
+            "active_slots": self.active_slots,
+            "queue_depth": self.queue_depth,
+            "decode_steps": steps,
+            "prefills": self.prefills,
+            "admitted_total": self.admitted_total,
+            "finished_total": self.finished_total,
+            "batch_occupancy": (self.occupancy_sum / (steps * self.slots)
+                                if steps else 0.0),
+        }

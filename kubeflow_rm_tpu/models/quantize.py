@@ -18,10 +18,18 @@ jax-native.
   ~3.6 GB — comfortable on one 16 GiB v5e next to its KV cache.
   Leaves carry only stacked arrays (no scalar metadata) so they ride
   ``lax.scan`` over the layer axis like every other weight.
-  Measured tradeoff (BENCH_SWEEP_r04.json): int4 is a **capacity**
-  lever, not a speed lever — the per-matmul nibble unpack costs more
-  than the halved HBM reads (16.3 vs 5.0 ms/token for int8 at 1.2B),
-  so use int8 when the model fits and int4 when it wouldn't.
+- **int4 decode speed**: the nibble unpack is loop-invariant, so the
+  fused decode path hoists it out of the per-token scan
+  (``unpack_int4_params`` → ``{"q8g", "s"}`` group-shaped int8 leaves,
+  unpacked ONCE per generation) and each step pays only the int8→bf16
+  dequant prologue. Early revisions re-unpacked inside the scan body
+  every step, which made fused int4 8x+ slower than the per-token
+  loop (612.77 vs 137.07 ms/tok at B8/7B, BENCH_SWEEP_r05.json
+  ``decode_7b``) and earned the docstring claim that int4 was "a
+  capacity lever, not a speed lever". With the hoist that claim is
+  stale: fused int4 decodes at int8-like step cost (SERVE_r01.json
+  ``decode_int4`` re-measurement) while still storing a 7B in ~3.6 GB
+  packed + ~6.7 GB unpacked-resident during decode — both levers now.
 - The dequant multiply fuses into the matmul epilogue; XLA reads the
   narrow weights from HBM and converts in VMEM, which is exactly where
   the bandwidth win comes from. Norms (tiny) and the embedding (a
@@ -150,7 +158,46 @@ def init_params_quantized(cfg, key: jax.Array, bits: int = 8,
 
 def is_quantized(leaf) -> bool:
     return isinstance(leaf, dict) and set(leaf) in ({"q", "s"},
-                                                    {"q4", "s"})
+                                                    {"q4", "s"},
+                                                    {"q8g", "s"})
+
+
+def unpack_int4(leaf: dict) -> dict:
+    """Unpack a packed-int4 leaf to group-shaped int8 ``{"q8g", "s"}``.
+
+    The unpack here is byte-for-byte the ops the old in-scan q4 dequant
+    performed, so ``maybe_dequant`` on the result is bit-identical to
+    dequanting the packed form directly — the fused decode loop relies
+    on that for loop/fused parity. Unlike plain int8 ``{"q", "s"}``,
+    the group axes are kept so the per-group scales still broadcast.
+    Doubles the weight bytes vs packed (int8 vs two nibbles/byte);
+    intended as a transient inside a generation, not a storage format.
+    """
+    packed = leaf["q4"]                          # (..., G, g/2, out)
+    hi = packed >> 4                             # arithmetic: sign ok
+    lo = (packed << 4).astype(jnp.int8) >> 4
+    q = jnp.stack([hi, lo], axis=-2)             # (..., G, g/2, 2, out)
+    gshape = packed.shape[:-2] + (packed.shape[-2] * 2,) \
+        + packed.shape[-1:]
+    return {"q8g": q.reshape(gshape), "s": leaf["s"]}
+
+
+def unpack_int4_params(params):
+    """Rewrite every packed-int4 leaf in a param tree to its unpacked
+    ``{"q8g", "s"}`` form; every other leaf passes through untouched.
+
+    Called ONCE at the top of the fused decode paths (outside the
+    per-token scan) so nibble unpacking is loop-invariant — the fix
+    for the 612.77 ms/tok fused-int4 trap. No-op on int8/bf16 trees.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: unpack_int4(x) if isinstance(x, dict) and "q4" in x
+        else x,
+        params,
+        is_leaf=lambda x: isinstance(x, dict) and ("q4" in x or
+                                                   "q8g" in x or
+                                                   "q" in x),
+    )
 
 
 def maybe_dequant(leaf, dtype) -> jax.Array:
@@ -160,16 +207,12 @@ def maybe_dequant(leaf, dtype) -> jax.Array:
     if not isinstance(leaf, dict):
         return leaf.astype(dtype)
     if "q4" in leaf:
-        packed = leaf["q4"]                      # (..., G, g/2, out)
-        hi = packed >> 4                         # arithmetic: sign ok
-        lo = (packed << 4).astype(jnp.int8) >> 4
-        q = jnp.stack([hi, lo], axis=-2)         # (..., G, g/2, 2, out)
-        gshape = packed.shape[:-2] + (packed.shape[-2] * 2,) \
-            + packed.shape[-1:]
-        q = q.reshape(gshape)                    # (..., G, g, out)
+        leaf = unpack_int4(leaf)
+    if "q8g" in leaf:
+        q = leaf["q8g"]                          # (..., G, g, out)
         w = q.astype(dtype) * leaf["s"].astype(dtype)
-        K = gshape[-3] * gshape[-2]
-        return w.reshape(gshape[:-3] + (K,) + gshape[-1:])
+        K = q.shape[-3] * q.shape[-2]
+        return w.reshape(q.shape[:-3] + (K,) + q.shape[-1:])
     return (leaf["q"].astype(dtype) * leaf["s"].astype(dtype))
 
 

@@ -346,3 +346,98 @@ def test_sampling_requires_key(model):
     with pytest.raises(ValueError, match="PRNG key"):
         generate(params, cfg, jnp.ones((1, 2), jnp.int32),
                  max_new_tokens=1, temperature=0.7)
+
+
+def test_fused_int4_matches_loop_tokenwise(model):
+    """The unpack-once fix must not change a single token: fused int4
+    decode (nibbles unpacked ahead of the scan) vs the per-token loop
+    (which dequants packed leaves in place), and vs the pre-fix trace
+    that re-unpacks inside the scan (``set_unpack_once(False)``)."""
+    from kubeflow_rm_tpu.models.generate import (
+        generate_fused, set_unpack_once,
+    )
+    from kubeflow_rm_tpu.models.quantize import quantize_params
+
+    cfg, params = model
+    q4 = quantize_params(params, bits=4)
+    prompt = jax.random.randint(jax.random.key(40), (2, 6), 1,
+                                cfg.vocab_size)
+    loop = generate(q4, cfg, prompt, max_new_tokens=7)
+    fused = generate_fused(q4, cfg, prompt, max_new_tokens=7)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(loop))
+    try:
+        set_unpack_once(False)
+        refused = generate_fused(q4, cfg, prompt, max_new_tokens=7)
+    finally:
+        set_unpack_once(True)
+    np.testing.assert_array_equal(np.asarray(refused), np.asarray(loop))
+
+
+def test_engine_matches_one_shot_fused(model):
+    """Continuous batching's exactness contract: every request decodes
+    bit-identically to a solo ``generate_fused`` call with the same
+    slot-sized cache — across ragged prompt lengths, different token
+    budgets, early-EOS retirement, and mid-flight admission (more
+    requests than slots, so slots are recycled)."""
+    from kubeflow_rm_tpu.models.generate import (
+        ContinuousBatchingEngine, generate_fused,
+    )
+
+    cfg, params = model
+    slot_len = 32
+    eng = ContinuousBatchingEngine(params, cfg, slots=2,
+                                   slot_len=slot_len)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n).tolist()
+               for n in (3, 7, 5, 8)]
+    budgets = [4, 9, 6, 5]
+    # request 2 retires early: its eos is the model's own first greedy
+    # continuation token
+    eos_tok = int(jnp.argmax(forward(
+        params, jnp.asarray([prompts[2]], jnp.int32), cfg)[0, -1]))
+    eos_ids = [None, None, eos_tok, None]
+    reqs = [eng.submit(p, max_new_tokens=m, eos_id=e)
+            for p, m, e in zip(prompts, budgets, eos_ids)]
+    done = eng.run()
+    assert len(done) == len(reqs) and all(r.done for r in reqs)
+
+    for p, m, e, r in zip(prompts, budgets, eos_ids, reqs):
+        ref = generate_fused(params, cfg, jnp.asarray([p], jnp.int32),
+                             max_new_tokens=m, max_len=slot_len,
+                             eos_id=e)
+        exp = np.asarray(ref[0, len(p):]).tolist()
+        if e is not None and e in exp:    # fused latches eos; the
+            exp = exp[:exp.index(e) + 1]  # engine retires the slot
+        assert r.tokens == exp
+    assert reqs[2].tokens == [eos_tok]    # early retirement happened
+
+    stats = eng.stats()
+    assert stats["finished_total"] == 4
+    assert stats["prefills"] == 4
+    assert stats["active_slots"] == 0 and stats["queue_depth"] == 0
+    assert 0 < stats["batch_occupancy"] <= 1.0
+
+
+def test_engine_validation_and_sampling(model):
+    """Capacity guard (prefill bucket + budget must fit the slot),
+    empty prompts, the sampling key requirement — and that a sampled
+    request is reproducible from its key."""
+    from kubeflow_rm_tpu.models.generate import ContinuousBatchingEngine
+
+    cfg, params = model
+    eng = ContinuousBatchingEngine(params, cfg, slots=1, slot_len=16)
+    with pytest.raises(ValueError, match="slot_len"):
+        eng.submit(list(range(1, 10)), max_new_tokens=8)  # 16+8 > 16
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit([], max_new_tokens=2)
+    with pytest.raises(ValueError, match="key"):
+        eng.submit([1, 2], max_new_tokens=2, temperature=0.7)
+
+    outs = []
+    for _ in range(2):
+        e = ContinuousBatchingEngine(params, cfg, slots=1, slot_len=16)
+        r = e.submit([3, 5, 7], max_new_tokens=6, temperature=0.8,
+                     top_k=5, key=jax.random.key(42))
+        e.run()
+        outs.append(r.tokens)
+    assert outs[0] == outs[1] and len(outs[0]) == 6

@@ -203,3 +203,43 @@ def test_init_params_quantized_moe_dispatch():
         assert dp_ == tp_
         np.testing.assert_allclose(np.asarray(dv), np.asarray(tv),
                                    atol=1e-6, err_msg=str(dp_))
+
+
+def test_unpack_int4_bit_identical_dequant(model):
+    """The hoisted decode path's correctness anchor: unpacking q4
+    nibbles to the transient int8 ``q8g`` form and dequanting must be
+    BIT-identical to dequanting the packed leaf in place — that's what
+    makes unpack-once a pure perf change."""
+    from kubeflow_rm_tpu.models.quantize import (
+        unpack_int4, unpack_int4_params,
+    )
+
+    cfg, params = model
+    q4 = quantize_params(params, bits=4)
+    unpacked = unpack_int4_params(q4)
+
+    q4_leaves = jax.tree_util.tree_leaves(q4, is_leaf=is_quantized)
+    un_leaves = jax.tree_util.tree_leaves(unpacked, is_leaf=is_quantized)
+    assert len(q4_leaves) == len(un_leaves)
+    saw_packed = 0
+    for a, b in zip(q4_leaves, un_leaves):
+        if isinstance(a, dict) and "q4" in a:
+            saw_packed += 1
+            assert set(b) == {"q8g", "s"}
+            # group dim doubles: two nibbles per packed byte
+            assert b["q8g"].shape[-2] == 2 * a["q4"].shape[-2]
+            assert b["q8g"].dtype == jnp.int8
+            np.testing.assert_array_equal(
+                np.asarray(unpack_int4(a)["q8g"]), np.asarray(b["q8g"]))
+        np.testing.assert_array_equal(
+            np.asarray(maybe_dequant(a, jnp.float32)),
+            np.asarray(maybe_dequant(b, jnp.float32)))
+    assert saw_packed > 0
+
+    # idempotent: already-unpacked (and int8 {q,s}) trees pass through
+    again = unpack_int4_params(unpacked)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(unpacked, is_leaf=is_quantized),
+            jax.tree_util.tree_leaves(again, is_leaf=is_quantized)):
+        if isinstance(a, dict):
+            assert set(a) == set(b)

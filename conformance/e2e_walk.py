@@ -127,6 +127,7 @@ class Walk:
         raise AssertionError(f"timed out waiting for {what}")
 
     def run(self, name, fn, skip: str | None = None):
+        from kubeflow_rm_tpu.controlplane import tracing
         if self.only is not None and name not in self.only:
             skip = skip or "filtered by --scenarios"
         t0 = time.perf_counter()
@@ -137,7 +138,15 @@ class Walk:
             print(f"  ~ {name}: skipped ({skip})", flush=True)
             return
         try:
-            detail = fn() or {}
+            # each scenario is one root trace: its kube calls carry the
+            # context, so the artifact can show the blocking chain of a
+            # slow scenario (no-op unless --tracing)
+            with tracing.start_span(f"scenario {name}", kind="client",
+                                    root=True) as root:
+                detail = fn() or {}
+            tid = getattr(root, "trace_id", None)
+            if tid:
+                rec["trace_id"] = tid
             rec.update(ok=True, ms=round(1e3 * (time.perf_counter() - t0),
                                          1), **detail)
             print(f"  ✓ {name} ({rec['ms']} ms)", flush=True)
@@ -830,8 +839,20 @@ def main() -> int:
                     help="comma-separated subset to run (others are "
                          "recorded as skipped); scenarios share state "
                          "— pick prefixes of the full walk order")
+    ap.add_argument("--tracing", action="store_true",
+                    help="local backend: collect a distributed trace "
+                         "per scenario (root span around each, spans "
+                         "from every control-plane hop)")
+    ap.add_argument("--trace-out", default="",
+                    help="write per-scenario traces + critical paths "
+                         "to this JSON file (with --tracing)")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
+
+    from kubeflow_rm_tpu.controlplane import tracing
+    if args.tracing and args.backend == "local":
+        tracing.set_enabled(True)
+        tracing.set_process("e2e")
 
     import threading
     stop = threading.Event()
@@ -868,6 +889,32 @@ def main() -> int:
         "skipped": len(results) - len(ran),
         "total_s": round(time.time() - t0, 2),
     }
+    if tracing.enabled():
+        spans = tracing.collector().spans()
+        by_trace: dict[str, list] = {}
+        for s in spans:
+            by_trace.setdefault(s["trace_id"], []).append(s)
+        traces = []
+        for rec in results:
+            tid = rec.get("trace_id")
+            tspans = sorted(by_trace.get(tid, []),
+                            key=lambda s: s["start"]) if tid else []
+            if not tspans:
+                continue
+            cp = tracing.critical_path(tspans)
+            traces.append({
+                "scenario": rec["scenario"],
+                "trace_id": tid,
+                "measured_ms": rec.get("ms"),
+                "self_ms_total": round(
+                    sum(h["self_ms"] for h in cp), 3),
+                "hops": len(cp),
+                "critical_path": cp,
+            })
+        artifact["trace"] = {"count": len(traces), "scenarios": traces}
+        if args.trace_out:
+            with open(args.trace_out, "w") as f:
+                json.dump(artifact["trace"], f, indent=1)
     print(json.dumps(artifact))
     if args.out:
         with open(args.out, "w") as f:

@@ -55,12 +55,19 @@ def wallclock_main(args) -> int:
     from the apiserver write log (utils/profiling.PhaseRecorder)."""
     import statistics
 
+    from kubeflow_rm_tpu.controlplane import tracing
     from kubeflow_rm_tpu.utils.profiling import PhaseRecorder
 
+    if not args.no_tracing:
+        # the harness is the trace ROOT process: every spawn opens a
+        # client span around POST→Ready and propagates it over HTTP
+        tracing.set_enabled(True)
+        tracing.set_process("harness")
     phases = PhaseRecorder()
     runs = []
     throttled = {"calls": 0, "seconds": 0.0}
     readiness = {"status_gets": 0, "readiness_gets": 0}
+    trace_reports = []
     once = _wallclock_once_sharded if args.shards > 1 else _wallclock_once
     for r in range(max(1, args.runs)):
         res = once(args, phases)
@@ -72,6 +79,9 @@ def wallclock_main(args) -> int:
         if rd:
             readiness["status_gets"] += rd["status_gets"]
             readiness["readiness_gets"] += rd["readiness_gets"]
+        rep = res.pop("_trace", None)
+        if rep:
+            trace_reports.append(rep)
         runs.append(res)
         print(f"run {r + 1}/{args.runs}: "
               f"p50={res['provision_p50_ms']}ms "
@@ -111,12 +121,115 @@ def wallclock_main(args) -> int:
             "calls": throttled["calls"],
             "seconds": round(throttled["seconds"], 3),
         }
+    result["tracing"] = not args.no_tracing
+    if trace_reports:
+        trace_section = _merge_trace_reports(trace_reports)
+        # the slowest trace rides the printed result WITHOUT its full
+        # span list (that lives in the --trace-out artifact)
+        result["trace"] = {
+            "count": trace_section["count"],
+            "slowest": ({k: v for k, v in
+                         trace_section["slowest"].items()
+                         if k != "spans"}
+                        if trace_section["slowest"] else None),
+            "phase_exemplars": trace_section["phase_exemplars"],
+        }
+        if args.trace_out:
+            artifact = {
+                "mode": "wallclock",
+                "shards": args.shards,
+                "notebooks": args.notebooks,
+                "concurrency": max(1, args.concurrency),
+                "runs": len(runs),
+                "provision_p50_ms": result["provision_p50_ms"],
+                **trace_section,
+            }
+            with open(args.trace_out, "w") as f:
+                json.dump(artifact, f, indent=1)
     print(json.dumps(result))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=1)
     print("CONFORMANCE OK (wallclock)")
     return 0
+
+
+def _trace_report(spawn_traces, span_lists) -> dict:
+    """Reduce one run's spans to per-spawn trace summaries.
+
+    ``spawn_traces``: ``(name, trace_id, measured_s)`` per spawn;
+    ``span_lists``: raw span-dict lists from every participating
+    process (the harness collector + each shard's ``/debug/traces``).
+    The slowest provision keeps its full span list and critical path —
+    the TRACE artifact's centerpiece — others keep summaries."""
+    from kubeflow_rm_tpu.controlplane import tracing
+
+    spans = tracing.merge_spans(*span_lists)
+    by_trace: dict[str, list[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    traces = []
+    for name, tid, measured_s in spawn_traces:
+        tspans = sorted(by_trace.get(tid, []),
+                        key=lambda s: s["start"])
+        if not tspans:
+            continue
+        cp = tracing.critical_path(tspans)
+        roots = [s for s in tspans if not s.get("parent_id")]
+        dur = roots[0].get("duration_ms") if roots else None
+        traces.append({
+            "name": name,
+            "trace_id": tid,
+            "measured_ms": round(measured_s * 1e3, 1),
+            "duration_ms": dur,
+            # the critical-path invariant: these partition the root
+            # interval, so the sum must track duration_ms (and thus
+            # the measured wallclock) to within clock skew
+            "self_ms_total": round(sum(h["self_ms"] for h in cp), 3),
+            "hops": len(cp),
+            "processes": sorted({s.get("process") or ""
+                                 for s in tspans}),
+            "critical_path": cp,
+            "spans": tspans,
+        })
+    traces.sort(key=lambda t: -(t["duration_ms"] or 0))
+    phase_exemplars: dict[str, dict] = {}
+    for t in traces:
+        for h in t["critical_path"]:
+            ex = phase_exemplars.get(h["name"])
+            if ex is None or h["self_ms"] > ex["self_ms"]:
+                phase_exemplars[h["name"]] = {
+                    "trace_id": t["trace_id"],
+                    "self_ms": h["self_ms"]}
+    return {
+        "count": len(traces),
+        "slowest": traces[0] if traces else None,
+        "phase_exemplars": phase_exemplars,
+        "traces": [{k: t[k] for k in
+                    ("name", "trace_id", "measured_ms", "duration_ms",
+                     "self_ms_total", "hops", "processes")}
+                   for t in traces],
+    }
+
+
+def _merge_trace_reports(reports: list[dict]) -> dict:
+    """Across --runs boots: overall slowest + per-phase maxima."""
+    all_traces = [t for rep in reports for t in rep["traces"]]
+    slowest = None
+    for rep in reports:
+        t = rep.get("slowest")
+        if t and (slowest is None or
+                  (t.get("duration_ms") or 0) >
+                  (slowest.get("duration_ms") or 0)):
+            slowest = t
+    phase_exemplars: dict[str, dict] = {}
+    for rep in reports:
+        for name, ex in rep["phase_exemplars"].items():
+            cur = phase_exemplars.get(name)
+            if cur is None or ex["self_ms"] > cur["self_ms"]:
+                phase_exemplars[name] = ex
+    return {"count": len(all_traces), "slowest": slowest,
+            "phase_exemplars": phase_exemplars, "traces": all_traces}
 
 
 def _phases_from_write_log(write_log, prefix: str, hosts: int,
@@ -162,6 +275,7 @@ def _wallclock_once(args, phases) -> dict:
     from kubeflow_rm_tpu.controlplane import (
         WATCHED_KINDS,
         make_cluster_manager,
+        tracing,
     )
     from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
     from kubeflow_rm_tpu.controlplane.apiserver import APIServer
@@ -191,6 +305,9 @@ def _wallclock_once(args, phases) -> dict:
     )
 
     stop = threading.Event()
+    if tracing.enabled():
+        # per-run isolation: each --runs boot reports its own traces
+        tracing.collector().clear()
 
     # -- the cluster: apiserver + admission + fake kubelet over REST --
     capi = APIServer(global_lock=args.global_lock)
@@ -277,7 +394,12 @@ def _wallclock_once(args, phases) -> dict:
         and the client issues zero fixed-interval status GETs.
         ``--poll-readiness`` restores the old 50ms status-GET loop as
         the A/B baseline arm. Each worker carries its own Session —
-        requests Sessions are not thread-safe."""
+        requests Sessions are not thread-safe.
+
+        The whole POST→Ready interval runs inside a ROOT client span
+        whose traceparent rides every HTTP request of this spawn, so
+        the provision trace covers exactly the latency being measured
+        (no-op under --no-tracing)."""
         s = requests.Session()
         tok = secrets.token_urlsafe(16)
         s.cookies.set(CSRF_COOKIE, tok)
@@ -294,79 +416,89 @@ def _wallclock_once(args, phases) -> dict:
             "datavols": [],
         }
         t0 = time.perf_counter()
-        for attempt in range(3):
-            resp = s.post(
-                f"{jwa_url}/api/namespaces/conformance/notebooks",
-                json=body)
-            if resp.status_code == 200:
-                break
-            # a keep-alive reset mid-POST surfaces as a 500 with the
-            # create possibly landed — poll for the CR like the SPA
-            # would before re-submitting the form
-            got = s.get(f"{jwa_url}/api/namespaces/conformance/"
-                        f"notebooks/wc-{i}")
-            if got.status_code == 200:
-                break
-            time.sleep(0.1)
-        else:
-            raise AssertionError(f"wc-{i} POST failed: {resp.text}")
-        phases.record("post_return", time.perf_counter() - t0)
-        slice_deadline = time.monotonic() + 120
-        status_gets = 0
-        readiness_gets = 0
-        if args.poll_readiness:
-            while True:
-                # the list endpoint serves summaries without replica
-                # counts; the per-notebook GET returns the raw CR
-                resp = s.get(f"{jwa_url}/api/namespaces/conformance/"
-                             f"notebooks/wc-{i}")
-                status_gets += 1
-                nb = resp.json().get("notebook", {}) \
-                    if resp.status_code == 200 else {}
-                if (nb.get("status") or {}).get(
-                        "readyReplicas") == topo.hosts:
-                    break
-                if time.monotonic() > slice_deadline:
-                    raise AssertionError(
-                        f"wc-{i} never ready: {nb.get('status')}")
-                # fixed 50ms poll: with the parallel manager the server
-                # side absorbs N pollers fine, and a concurrency-scaled
-                # interval would quantize the very latency being
-                # measured (20-way × 20ms = 400ms floor — the old r4
-                # artifact's first ~fifth of its 2.05s p50 was the
-                # poll itself)
-                time.sleep(0.05)
-        else:
-            # push path: re-subscribe with the last observed
-            # resourceVersion; the server blocks until the CR moves,
-            # so there is no sleep anywhere in this loop
-            known = ""
-            while True:
-                resp = s.get(
-                    f"{jwa_url}/api/namespaces/conformance/"
-                    f"notebooks/wc-{i}/readiness",
-                    params={"timeoutSeconds": 30,
-                            "knownVersion": known})
-                readiness_gets += 1
+        with tracing.start_span(f"provision wc-{i}", kind="client",
+                                root=True,
+                                attrs={"notebook": f"wc-{i}"}) as root:
+            tp = root.to_traceparent()
+            if tp:
+                s.headers[tracing.TRACE_HEADER] = tp
+            for attempt in range(3):
+                resp = s.post(
+                    f"{jwa_url}/api/namespaces/conformance/notebooks",
+                    json=body)
                 if resp.status_code == 200:
-                    nb = resp.json().get("notebook", {})
+                    break
+                # a keep-alive reset mid-POST surfaces as a 500 with
+                # the create possibly landed — poll for the CR like
+                # the SPA would before re-submitting the form
+                got = s.get(f"{jwa_url}/api/namespaces/conformance/"
+                            f"notebooks/wc-{i}")
+                if got.status_code == 200:
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(f"wc-{i} POST failed: {resp.text}")
+            phases.record("post_return", time.perf_counter() - t0)
+            slice_deadline = time.monotonic() + 120
+            status_gets = 0
+            readiness_gets = 0
+            if args.poll_readiness:
+                while True:
+                    # the list endpoint serves summaries without
+                    # replica counts; the per-notebook GET returns the
+                    # raw CR
+                    resp = s.get(
+                        f"{jwa_url}/api/namespaces/conformance/"
+                        f"notebooks/wc-{i}")
+                    status_gets += 1
+                    nb = resp.json().get("notebook", {}) \
+                        if resp.status_code == 200 else {}
                     if (nb.get("status") or {}).get(
                             "readyReplicas") == topo.hosts:
                         break
-                    known = str((nb.get("metadata") or {}).get(
-                        "resourceVersion") or "")
-                else:
-                    # 404 = long-poll expired before the CR became
-                    # visible to the web app's informer — re-subscribe
-                    # from scratch (still no fixed-interval sleep)
-                    known = ""
-                if time.monotonic() > slice_deadline:
-                    raise AssertionError(
-                        f"wc-{i} never ready: "
-                        f"{resp.status_code} {resp.text[:200]}")
+                    if time.monotonic() > slice_deadline:
+                        raise AssertionError(
+                            f"wc-{i} never ready: {nb.get('status')}")
+                    # fixed 50ms poll: with the parallel manager the
+                    # server side absorbs N pollers fine, and a
+                    # concurrency-scaled interval would quantize the
+                    # very latency being measured (20-way × 20ms =
+                    # 400ms floor — the old r4 artifact's first ~fifth
+                    # of its 2.05s p50 was the poll itself)
+                    time.sleep(0.05)
+            else:
+                # push path: re-subscribe with the last observed
+                # resourceVersion; the server blocks until the CR
+                # moves, so there is no sleep anywhere in this loop
+                known = ""
+                while True:
+                    resp = s.get(
+                        f"{jwa_url}/api/namespaces/conformance/"
+                        f"notebooks/wc-{i}/readiness",
+                        params={"timeoutSeconds": 30,
+                                "knownVersion": known})
+                    readiness_gets += 1
+                    if resp.status_code == 200:
+                        nb = resp.json().get("notebook", {})
+                        if (nb.get("status") or {}).get(
+                                "readyReplicas") == topo.hosts:
+                            break
+                        known = str((nb.get("metadata") or {}).get(
+                            "resourceVersion") or "")
+                    else:
+                        # 404 = long-poll expired before the CR became
+                        # visible to the web app's informer — re-
+                        # subscribe from scratch (still no fixed-
+                        # interval sleep)
+                        known = ""
+                    if time.monotonic() > slice_deadline:
+                        raise AssertionError(
+                            f"wc-{i} never ready: "
+                            f"{resp.status_code} {resp.text[:200]}")
         return {"latency": time.perf_counter() - t0,
                 "status_gets": status_gets,
-                "readiness_gets": readiness_gets}
+                "readiness_gets": readiness_gets,
+                "trace_id": getattr(root, "trace_id", None)}
 
     t_start = time.perf_counter()
     try:
@@ -379,6 +511,15 @@ def _wallclock_once(args, phases) -> dict:
         total = time.perf_counter() - t_start
         _phases_from_write_log(list(capi.write_log), "wc-",
                                topo.hosts, phases)
+        trace_report = None
+        if tracing.enabled():
+            # everything ran in THIS process (webapp, manager, cluster)
+            # so the local collector holds the whole causal chain
+            spawn_traces = [(f"wc-{i}", sp["trace_id"], sp["latency"])
+                            for i, sp in enumerate(spawns)
+                            if sp.get("trace_id")]
+            trace_report = _trace_report(
+                spawn_traces, [tracing.collector().spans()])
     finally:
         stop.set()
         # flush in-flight fanout deliveries before tearing the sockets
@@ -410,6 +551,8 @@ def _wallclock_once(args, phases) -> dict:
             "calls": kapi.limiter.throttled_calls,
             "seconds": kapi.limiter.throttled_seconds,
         }
+    if trace_report is not None:
+        result["_trace"] = trace_report
     return result
 
 
@@ -432,6 +575,7 @@ def _wallclock_once_sharded(args, phases) -> dict:
 
     import requests
 
+    from kubeflow_rm_tpu.controlplane import tracing
     from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
         ShardedKubeAPIServer,
     )
@@ -444,11 +588,14 @@ def _wallclock_once_sharded(args, phases) -> dict:
     )
 
     stop = threading.Event()
+    if tracing.enabled():
+        tracing.collector().clear()
     base_dir = tempfile.mkdtemp(prefix="conf-shards-")
     runner = ShardRunner(args.shards, base_dir=base_dir,
                          wal=not args.no_wal,
                          manager_workers=args.manager_workers,
-                         hang_dump_s=args.hang_dump)
+                         hang_dump_s=args.hang_dump,
+                         tracing=tracing.enabled())
     runner.start(timeout=120)
 
     router = ShardedKubeAPIServer(runner.urls, identity="conformance-web",
@@ -531,61 +678,70 @@ def _wallclock_once_sharded(args, phases) -> dict:
             "datavols": [],
         }
         t0 = time.perf_counter()
-        for attempt in range(3):
-            resp = s.post(
-                f"{jwa_url}/api/namespaces/{ns}/notebooks", json=body)
-            if resp.status_code == 200:
-                break
-            got = s.get(f"{jwa_url}/api/namespaces/{ns}/"
-                        f"notebooks/wc-{i}")
-            if got.status_code == 200:
-                break
-            time.sleep(0.1)
-        else:
-            raise AssertionError(f"wc-{i} POST failed: {resp.text}")
-        phases.record("post_return", time.perf_counter() - t0)
-        slice_deadline = time.monotonic() + 180
-        status_gets = 0
-        readiness_gets = 0
-        if args.poll_readiness:
-            while True:
-                resp = s.get(f"{jwa_url}/api/namespaces/{ns}/"
-                             f"notebooks/wc-{i}")
-                status_gets += 1
-                nb = resp.json().get("notebook", {}) \
-                    if resp.status_code == 200 else {}
-                if (nb.get("status") or {}).get(
-                        "readyReplicas") == topo.hosts:
-                    break
-                if time.monotonic() > slice_deadline:
-                    raise AssertionError(
-                        f"wc-{i} never ready: {nb.get('status')}")
-                time.sleep(0.05)
-        else:
-            known = ""
-            while True:
-                resp = s.get(
-                    f"{jwa_url}/api/namespaces/{ns}/"
-                    f"notebooks/wc-{i}/readiness",
-                    params={"timeoutSeconds": 30,
-                            "knownVersion": known})
-                readiness_gets += 1
+        with tracing.start_span(f"provision wc-{i}", kind="client",
+                                root=True,
+                                attrs={"notebook": f"wc-{i}",
+                                       "namespace": ns}) as root:
+            tp = root.to_traceparent()
+            if tp:
+                s.headers[tracing.TRACE_HEADER] = tp
+            for attempt in range(3):
+                resp = s.post(
+                    f"{jwa_url}/api/namespaces/{ns}/notebooks",
+                    json=body)
                 if resp.status_code == 200:
-                    nb = resp.json().get("notebook", {})
+                    break
+                got = s.get(f"{jwa_url}/api/namespaces/{ns}/"
+                            f"notebooks/wc-{i}")
+                if got.status_code == 200:
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError(f"wc-{i} POST failed: {resp.text}")
+            phases.record("post_return", time.perf_counter() - t0)
+            slice_deadline = time.monotonic() + 180
+            status_gets = 0
+            readiness_gets = 0
+            if args.poll_readiness:
+                while True:
+                    resp = s.get(f"{jwa_url}/api/namespaces/{ns}/"
+                                 f"notebooks/wc-{i}")
+                    status_gets += 1
+                    nb = resp.json().get("notebook", {}) \
+                        if resp.status_code == 200 else {}
                     if (nb.get("status") or {}).get(
                             "readyReplicas") == topo.hosts:
                         break
-                    known = str((nb.get("metadata") or {}).get(
-                        "resourceVersion") or "")
-                else:
-                    known = ""
-                if time.monotonic() > slice_deadline:
-                    raise AssertionError(
-                        f"wc-{i} never ready: "
-                        f"{resp.status_code} {resp.text[:200]}")
+                    if time.monotonic() > slice_deadline:
+                        raise AssertionError(
+                            f"wc-{i} never ready: {nb.get('status')}")
+                    time.sleep(0.05)
+            else:
+                known = ""
+                while True:
+                    resp = s.get(
+                        f"{jwa_url}/api/namespaces/{ns}/"
+                        f"notebooks/wc-{i}/readiness",
+                        params={"timeoutSeconds": 30,
+                                "knownVersion": known})
+                    readiness_gets += 1
+                    if resp.status_code == 200:
+                        nb = resp.json().get("notebook", {})
+                        if (nb.get("status") or {}).get(
+                                "readyReplicas") == topo.hosts:
+                            break
+                        known = str((nb.get("metadata") or {}).get(
+                            "resourceVersion") or "")
+                    else:
+                        known = ""
+                    if time.monotonic() > slice_deadline:
+                        raise AssertionError(
+                            f"wc-{i} never ready: "
+                            f"{resp.status_code} {resp.text[:200]}")
         return {"latency": time.perf_counter() - t0,
                 "status_gets": status_gets,
-                "readiness_gets": readiness_gets}
+                "readiness_gets": readiness_gets,
+                "trace_id": getattr(root, "trace_id", None)}
 
     t_start = time.perf_counter()
     try:
@@ -606,6 +762,25 @@ def _wallclock_once_sharded(args, phases) -> dict:
                 merged.extend(json.loads(r.read())["writes"])
         merged.sort(key=lambda e: e["t"])
         _phases_from_write_log(merged, "wc-", topo.hosts, phases)
+        trace_report = None
+        if tracing.enabled():
+            # a trace's spans are SCATTERED: the harness holds the
+            # client roots + webapp server spans, each shard process
+            # holds its apiserver/reconcile/scheduler hops — pull every
+            # shard's export and merge before the critical-path pass
+            span_lists = [tracing.collector().spans()]
+            for url in runner.urls.values():
+                try:
+                    with urllib.request.urlopen(
+                            url + "/debug/traces", timeout=10) as r:
+                        span_lists.append(
+                            json.loads(r.read())["spans"])
+                except OSError:
+                    pass  # a chaos-killed shard loses its spans
+            spawn_traces = [(f"wc-{i}", sp["trace_id"], sp["latency"])
+                            for i, sp in enumerate(spawns)
+                            if sp.get("trace_id")]
+            trace_report = _trace_report(spawn_traces, span_lists)
     finally:
         stop.set()
         httpd.shutdown()
@@ -636,6 +811,8 @@ def _wallclock_once_sharded(args, phases) -> dict:
             "calls": sum(lim.throttled_calls for lim in limiters),
             "seconds": sum(lim.throttled_seconds for lim in limiters),
         }
+    if trace_report is not None:
+        result["_trace"] = trace_report
     return result
 
 
@@ -708,6 +885,16 @@ def main() -> int:
                     help="arm faulthandler to dump every thread's "
                          "stack after S seconds (CI contention-stress "
                          "deadlock canary; 0 = off)")
+    ap.add_argument("--no-tracing", action="store_true",
+                    help="wallclock mode: disable distributed tracing "
+                         "(the overhead A/B baseline arm; spans are "
+                         "otherwise collected end-to-end from POST to "
+                         "Ready across every process)")
+    ap.add_argument("--trace-out", default="",
+                    help="write the trace artifact JSON here "
+                         "(TRACE_r{N}.json: slowest provision's full "
+                         "span tree + critical path, per-phase "
+                         "exemplars; wallclock mode with tracing on)")
     ap.add_argument("--out", default="",
                     help="also write the result JSON to this file "
                          "(PROVISION_r{N}.json artifact)")

@@ -36,6 +36,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     parse_quantity,
     strategic_merge,
 )
+from kubeflow_rm_tpu.controlplane import tracing
 
 CLUSTER_SCOPED_KINDS = {
     "Namespace", "Profile", "Node", "ClusterRole", "ClusterRoleBinding",
@@ -533,8 +534,17 @@ class APIServer:
             ch.publish((event, obj_c, old_c, t))
 
     def _run_admission(self, op: str, obj: dict, old: dict | None) -> dict:
-        for pattern, fn in self._admission:
-            if fnmatch.fnmatch(obj["kind"], pattern):
+        matched = [fn for pattern, fn in self._admission
+                   if fnmatch.fnmatch(obj["kind"], pattern)]
+        if not matched:
+            return obj
+        # one child span covers the whole mutating chain — webhook
+        # latency (PodDefault merges, TPU injection) shows up as its
+        # own hop in the trace instead of hiding inside the verb
+        with tracing.start_span_if_active(f"admit {obj['kind']}",
+                                          attrs={"op": op,
+                                                 "hooks": len(matched)}):
+            for fn in matched:
                 result = fn(op, obj, old)
                 if result is not None:
                     obj = result
@@ -551,6 +561,11 @@ class APIServer:
     # ---- verbs -------------------------------------------------------
     def create(self, obj: dict) -> dict:
         obj = _fastcopy(obj)
+        # persist the causal chain: the creating request's trace
+        # context rides the object's annotations so watch consumers
+        # (workqueues, reconciles) resume the SAME trace later,
+        # possibly in another process
+        tracing.stamp(obj)
         kind = obj["kind"]
         name, ns = name_of(obj), namespace_of(obj)
         with self._kind_lock(kind):
@@ -613,6 +628,12 @@ class APIServer:
         results: list = [None] * len(objs)
         admitted: list = [None] * len(objs)
 
+        # bulk creates stamp on the CALLER's thread: _admit may run on
+        # the shared admission pool where the thread-local trace
+        # context of the submitting request is absent
+        for o in objs:
+            tracing.stamp(o)
+
         def _admit(i: int) -> None:
             o = objs[i]
             name, ns = name_of(o), namespace_of(o)
@@ -628,7 +649,14 @@ class APIServer:
                     self._validators[kind](o)
                 except Exception as e:
                     raise Invalid(f"{kind} {ns}/{name}: {e}") from e
-            admitted[i] = self._run_admission("CREATE", o, None)
+            if tracing.enabled():
+                # pool threads lack the submitter's thread-local span;
+                # re-attach from the stamp so admission spans join the
+                # originating trace instead of orphaning
+                with tracing.attach(tracing.context_of(o)):
+                    admitted[i] = self._run_admission("CREATE", o, None)
+            else:
+                admitted[i] = self._run_admission("CREATE", o, None)
 
         with self._kind_lock(kind):
             if self._global or len(objs) == 1:

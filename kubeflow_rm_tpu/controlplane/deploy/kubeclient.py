@@ -45,6 +45,7 @@ from kubeflow_rm_tpu.controlplane.apiserver import (
     NotFound,
     status_from_error,
 )
+from kubeflow_rm_tpu.controlplane import tracing
 
 log = logging.getLogger("kubeflow_rm_tpu.kubeclient")
 
@@ -321,6 +322,13 @@ class _FastSession:
         hdrs = dict(self._headers)
         if headers:
             hdrs.update(headers)
+        # propagate the live trace context on EVERY rest call — this
+        # single choke point covers all verbs of every session,
+        # including the per-shard sessions ShardedKubeAPIServer routes
+        # through, so a cross-shard hop stays one trace
+        tp = tracing.current_traceparent()
+        if tp is not None:
+            hdrs.setdefault(tracing.TRACE_HEADER, tp)
         if stream:
             conn = self._connect(timeout or 310)
             conn.request(method, path, body=body, headers=hdrs)

@@ -47,6 +47,7 @@ from kubeflow_rm_tpu.controlplane.apiserver import (
     is_status,
 )
 from kubeflow_rm_tpu.controlplane.deploy.kubeclient import RESOURCES
+from kubeflow_rm_tpu.controlplane import tracing
 
 log = logging.getLogger("kubeflow_rm_tpu.restserver")
 
@@ -232,6 +233,27 @@ class RestServer:
 
     # ---- request handling -------------------------------------------
     def _handle(self, handler: BaseHTTPRequestHandler) -> None:
+        # server-span boundary: adopt the client's traceparent (the
+        # kube adapter injects it per call) so cross-process hops —
+        # including shard-routed ones — stay one trace. Watch streams
+        # are exempt: a 300s stream is a subscription, not a hop.
+        if tracing.enabled() and "watch=true" not in handler.path:
+            parent = tracing.parse_traceparent(
+                handler.headers.get(tracing.TRACE_HEADER))
+            if parent is not None:
+                # only context-bearing requests get a span — informer
+                # lists/watch registrations and metric scrapes carry no
+                # traceparent and would otherwise mint orphan roots
+                path = handler.path.split("?", 1)[0]
+                with tracing.start_span(
+                        f"{handler.command} {path}", kind="server",
+                        parent=parent,
+                        attrs={"component": "restserver"}):
+                    self._handle_inner(handler)
+                return
+        self._handle_inner(handler)
+
+    def _handle_inner(self, handler: BaseHTTPRequestHandler) -> None:
         parsed = urlparse(handler.path)
         params = parse_qs(parsed.query)
         method = handler.command
@@ -261,6 +283,16 @@ class RestServer:
             # reconstructs cross-shard phase breakdowns from these)
             self._send(handler, 200,
                        {"writes": list(self.api.write_log)})
+            return
+        if parsed.path == "/debug/traces" and method == "GET":
+            # this process's span collector, serialized — the metrics
+            # service (and the sharded conformance harness) merges
+            # these per-shard exports into whole cross-process traces
+            col = tracing.collector()
+            self._send(handler, 200,
+                       {"process": tracing.process_name(),
+                        "spans": col.spans(),
+                        "slow": col.slow_traces()})
             return
         if parsed.path == "/metrics" and method == "GET":
             # Prometheus exposition of the control-plane registry —

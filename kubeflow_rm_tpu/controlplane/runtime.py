@@ -26,6 +26,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     namespace_of,
 )
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer, Conflict, NotFound
+from kubeflow_rm_tpu.controlplane import tracing
 
 
 @dataclass(frozen=True, order=True)
@@ -108,6 +109,12 @@ class Manager:
         # guards the errors list; each queue carries its own lock
         self._queue_lock = threading.Lock()
         self.errors: list[tuple[str, Request, Exception]] = []
+        # trace context riding the workqueue: items are deduped frozen
+        # dataclasses, so causality travels in this side map keyed by
+        # (controller, request) and is popped when the reconcile opens
+        # its span. Bounded defensively — entries for requests that
+        # never dequeue (terminal retry paths) must not accumulate.
+        self._trace_ctx: dict[tuple[str, Request], str] = {}
         # run_forever blocks on this between drains; enqueue sets it so
         # watch events are served at HTTP latency, not poll latency
         self._wake = threading.Event()
@@ -129,8 +136,14 @@ class Manager:
             max_conflict_retries=self.MAX_CONFLICT_RETRIES,
             max_concurrent=getattr(controller, "max_concurrent", None)))
 
-    def enqueue(self, controller: Controller | str, req: Request) -> None:
+    def enqueue(self, controller: Controller | str, req: Request, *,
+                trace: str | None = None) -> None:
         name = controller if isinstance(controller, str) else controller.name
+        if trace is not None:
+            with self._queue_lock:
+                if len(self._trace_ctx) > 4096:
+                    self._trace_ctx.clear()  # defensive bound
+                self._trace_ctx[(name, req)] = trace
         self._queues[name].add(req)
         self._wake.set()
 
@@ -152,9 +165,16 @@ class Manager:
             # registered its watcher before ours)
             self.enqueue_all()
             return
+        # lift the stamped context off the event object so the async
+        # hop (watch → queue → reconcile thread) stays one trace
+        trace = None
+        if tracing.enabled():
+            ctx = tracing.context_of(obj)
+            trace = ctx.to_traceparent() if ctx is not None else None
         for c in self.controllers:
             if obj["kind"] == c.kind:
-                self.enqueue(c, Request(namespace_of(obj), name_of(obj)))
+                self.enqueue(c, Request(namespace_of(obj), name_of(obj)),
+                             trace=trace)
             for kind, map_fn in c.watches():
                 if obj["kind"] == kind:
                     reqs = (map_fn(self.api, obj)
@@ -162,7 +182,23 @@ class Manager:
                             else map_fn(obj))
                     for req in reqs:
                         if req.name:
-                            self.enqueue(c, req)
+                            self.enqueue(c, req, trace=trace)
+
+    def _reconcile_span(self, c: Controller, req: Request):
+        """Span context for one reconcile, parented on the trace the
+        workqueue item carried (consumed exactly once). No carried
+        context → no span: a periodic resync reconcile is not part of
+        any request's causal chain."""
+        import contextlib
+        if not tracing.enabled():
+            return contextlib.nullcontext()
+        with self._queue_lock:
+            tp = self._trace_ctx.pop((c.name, req), None)
+        if tp is None:
+            return contextlib.nullcontext()
+        return tracing.start_span(
+            f"reconcile {c.name}", kind="consumer", parent=tp,
+            attrs={"namespace": req.namespace or "", "name": req.name})
 
     def run_until_idle(self, max_iterations: int = 10_000) -> int:
         """Process queues until empty (timed requeues fire only when the
@@ -187,7 +223,8 @@ class Manager:
                 count += 1
                 q = self._queues[c.name]
                 try:
-                    requeue_after = c.reconcile(self.api, req)
+                    with self._reconcile_span(c, req):
+                        requeue_after = c.reconcile(self.api, req)
                     q.forget(req)
                     if requeue_after is not None:
                         q.add_after(req, requeue_after)
@@ -333,7 +370,8 @@ class Manager:
         q = self._queues[c.name]
         try:
             try:
-                requeue_after = c.reconcile(self.api, req)
+                with self._reconcile_span(c, req):
+                    requeue_after = c.reconcile(self.api, req)
                 q.forget(req)
                 if requeue_after is not None:
                     q.add_after(req, requeue_after)
@@ -521,7 +559,11 @@ def phase_observer(controller: str, recorder=None):
                     controller=controller, phase=phase))
         t0 = _time.perf_counter()
         try:
-            yield
+            # the same boundary also emits a trace span: reconcile
+            # phases become hops of the request's causal chain (no-op
+            # when tracing is off or no reconcile span is open)
+            with tracing.start_span_if_active(f"{controller}.{phase}"):
+                yield
         finally:
             dt = _time.perf_counter() - t0
             hist.observe(dt)

@@ -261,13 +261,17 @@ class SchedulerCache:
         None (nothing charged) when the gang doesn't fit. The caller
         must ``confirm`` each bind after its apiserver write lands, or
         ``forget`` it on failure."""
-        from kubeflow_rm_tpu.controlplane import metrics
+        from kubeflow_rm_tpu.controlplane import metrics, tracing
         self._ensure_fresh()
-        t0 = time.perf_counter()
-        plan = self._try_gang(pods, allow_virtual)
-        metrics.SCHEDULE_LATENCY_SECONDS.labels(
-            result="bound" if plan is not None
-            else "unschedulable").observe(time.perf_counter() - t0)
+        with tracing.start_span_if_active(
+                "gang_bind", attrs={"pods": len(pods),
+                                    "allow_virtual": allow_virtual}) as sp:
+            t0 = time.perf_counter()
+            plan = self._try_gang(pods, allow_virtual)
+            result = "bound" if plan is not None else "unschedulable"
+            metrics.SCHEDULE_LATENCY_SECONDS.labels(
+                result=result).observe(time.perf_counter() - t0)
+            sp.set_attr("result", result)
         return plan
 
     def _try_gang(self, pods: list[dict],

@@ -37,7 +37,7 @@ class ShardRunner:
     def __init__(self, n_shards: int, *, base_dir: str | None = None,
                  wal: bool = True, manager_workers: int = 8,
                  auto_ready: bool = True, hang_dump_s: float = 0.0,
-                 supervise: bool = True):
+                 supervise: bool = True, tracing: bool = False):
         if n_shards < 1:
             raise ValueError("need at least one shard")
         self._ctx = multiprocessing.get_context("spawn")
@@ -57,6 +57,9 @@ class ShardRunner:
                 "name": name, "port": _free_port(), "wal_dir": wal_dir,
                 "manager_workers": manager_workers,
                 "auto_ready": auto_ready, "hang_dump_s": hang_dump_s,
+                # span collection in the worker: a respawned shard
+                # re-reads this, so the tracing arm survives chaos kills
+                "tracing": tracing,
             }
 
     # ---- topology ----------------------------------------------------

@@ -70,6 +70,12 @@ def shard_worker_main(cfg: dict) -> None:
 
     name = cfg["name"]
     metrics.set_shard(name)
+    if cfg.get("tracing"):
+        from kubeflow_rm_tpu.controlplane import tracing
+        tracing.set_enabled(True)
+        # spans exported via /debug/traces carry this so cross-shard
+        # merges can show which process each hop ran in
+        tracing.set_process(name)
     stop = threading.Event()
 
     # -- the shard's cluster: apiserver (+WAL) + admission + kubelet --

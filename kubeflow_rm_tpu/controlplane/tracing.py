@@ -1,0 +1,567 @@
+"""End-to-end distributed tracing for the control plane.
+
+Every observability signal before this module was an *aggregate* —
+histograms and ``PhaseRecorder`` percentiles can say provision p95 is
+458 ms but not why ONE notebook took 2 s. This module adds the causal
+layer: W3C-traceparent-style contexts (trace_id / span_id / parent_id)
+carried across every boundary a request crosses:
+
+- **threads**: a thread-local current span; ``start_span`` parents new
+  spans on it automatically.
+- **HTTP hops**: clients inject a ``traceparent`` header
+  (``deploy/kubeclient.py``), servers extract it and open a server
+  span (``deploy/restserver.py``, ``webapps/core.py``) — cross-shard
+  hops through ``ShardedKubeAPIServer`` stay one trace.
+- **async causality**: writes stamp the live context into the object's
+  ``tpu.kubeflow.org/trace`` annotation, the controller runtime lifts
+  it off watch events into workqueue items, and the reconcile opens a
+  child span — the POST that created a Notebook parents the reconcile
+  that runs 50 ms later on another thread (or another process).
+
+Spans land in a per-process ``SpanCollector``: a bounded ring (recent
+spans, lock held only for an append) plus tail-sampled *slow-trace*
+retention — when a ROOT span ends slower than the retention threshold
+the whole trace is copied aside before ring eviction can shred it, so
+the interesting exemplars survive a storm. ``critical_path`` reduces a
+trace's span tree to the ordered blocking chain with per-hop
+self-time; the segments partition the root interval, so self-times sum
+to the root's wallclock by construction.
+
+Tracing is OFF by default and the disabled path is near-zero cost:
+``start_span`` returns a shared no-op context manager after one
+boolean check, and propagation call sites gate on ``enabled()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+#: annotation key carrying a serialized context across async hops
+TRACE_ANNOTATION = "tpu.kubeflow.org/trace"
+#: HTTP header (W3C trace-context). Version 00, sampled flag 01.
+TRACE_HEADER = "traceparent"
+
+_tls = threading.local()
+
+
+# ---------------------------------------------------------------------------
+# ids — os.urandom is ~100ns and needs no seeding discipline across
+# the spawn'd shard processes (a shared PRNG state would collide)
+# ---------------------------------------------------------------------------
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+# ---------------------------------------------------------------------------
+# span + context
+# ---------------------------------------------------------------------------
+
+class SpanContext:
+    """Just enough identity to parent a remote/async child."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"SpanContext({self.to_traceparent()})"
+
+
+class Span:
+    """One timed operation. ``start``/``end`` are epoch seconds
+    (``time.time()``) so spans from different PROCESSES on the same
+    host order correctly — perf_counter bases diverge across spawn."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "kind",
+                 "start", "end", "attrs", "process")
+
+    def __init__(self, name: str, *, trace_id: str, span_id: str,
+                 parent_id: str | None, kind: str = "internal",
+                 start: float | None = None,
+                 attrs: dict | None = None, process: str = ""):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.kind = kind
+        self.start = time.time() if start is None else start
+        self.end: float | None = None
+        self.attrs = attrs or {}
+        self.process = process
+
+    # context-ish surface so callers can parent on a live span
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def duration_ms(self) -> float | None:
+        if self.end is None:
+            return None
+        return (self.end - self.start) * 1e3
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "kind": self.kind,
+            "start": self.start,
+            "end": self.end,
+            "duration_ms": None if self.end is None
+            else round((self.end - self.start) * 1e3, 3),
+            "process": self.process,
+            "attrs": self.attrs,
+        }
+
+
+def parse_traceparent(header: str | None) -> SpanContext | None:
+    """``00-<32 hex>-<16 hex>-<2 hex>`` → SpanContext, else None.
+    Tolerant: malformed headers are dropped, never raised on — a bad
+    client must not 500 the apiserver."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, _ = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return SpanContext(trace_id, span_id)
+
+
+# ---------------------------------------------------------------------------
+# collector: bounded ring + tail-sampled slow-trace retention
+# ---------------------------------------------------------------------------
+
+class SpanCollector:
+    """Per-process span sink.
+
+    ``add`` appends to a bounded ring under a lock held only for the
+    append (deque.append is O(1); eviction is implicit). When a ROOT
+    span (no parent) finishes slower than ``slow_threshold_s`` — the
+    tail-sampling decision, made when the outcome is KNOWN — the whole
+    trace is copied into the slow store, itself bounded to the
+    ``slow_keep`` slowest traces so a storm cannot grow it unbounded.
+    """
+
+    def __init__(self, capacity: int = 8192, *,
+                 slow_threshold_s: float = 0.25, slow_keep: int = 32):
+        self._lock = threading.Lock()
+        self._ring: deque[Span] = deque(maxlen=capacity)
+        self.slow_threshold_s = slow_threshold_s
+        self.slow_keep = slow_keep
+        # trace_id -> (root_duration_s, [span dicts])
+        self._slow: dict[str, tuple[float, list[dict]]] = {}
+        self.dropped = 0
+        self.added = 0
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(span)
+            self.added += 1
+            if (span.parent_id is None and span.end is not None
+                    and span.end - span.start >= self.slow_threshold_s):
+                self._retain_slow_locked(span)
+
+    def _retain_slow_locked(self, root: Span) -> None:
+        dur = root.end - root.start
+        if len(self._slow) >= self.slow_keep:
+            fastest = min(self._slow, key=lambda t: self._slow[t][0])
+            if self._slow[fastest][0] >= dur:
+                return  # slower traces already retained; drop this one
+            del self._slow[fastest]
+        spans = [s.to_dict() for s in self._ring
+                 if s.trace_id == root.trace_id]
+        self._slow[root.trace_id] = (dur, spans)
+
+    # -- export --------------------------------------------------------
+    def spans(self) -> list[dict]:
+        """Every span currently held: ring ∪ slow store (deduped)."""
+        with self._lock:
+            out = {(s.trace_id, s.span_id): s.to_dict()
+                   for s in self._ring}
+            for _, spans in self._slow.values():
+                for d in spans:
+                    out.setdefault((d["trace_id"], d["span_id"]), d)
+        return list(out.values())
+
+    def traces(self) -> dict[str, list[dict]]:
+        grouped: dict[str, list[dict]] = {}
+        for d in self.spans():
+            grouped.setdefault(d["trace_id"], []).append(d)
+        for spans in grouped.values():
+            spans.sort(key=lambda d: d["start"])
+        return grouped
+
+    def get_trace(self, trace_id: str) -> list[dict]:
+        return sorted(
+            (d for d in self.spans() if d["trace_id"] == trace_id),
+            key=lambda d: d["start"])
+
+    def slow_traces(self) -> list[dict]:
+        """Tail-retained exemplars, slowest first."""
+        with self._lock:
+            items = sorted(self._slow.items(),
+                           key=lambda kv: kv[1][0], reverse=True)
+        return [{"trace_id": tid, "duration_ms": round(dur * 1e3, 3),
+                 "spans": spans} for tid, (dur, spans) in items]
+
+    def export_json(self) -> str:
+        return json.dumps({"spans": self.spans(),
+                           "slow": self.slow_traces(),
+                           "added": self.added,
+                           "dropped": self.dropped})
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._slow.clear()
+            self.dropped = 0
+            self.added = 0
+
+
+_collector = SpanCollector()
+_process_name = ""
+
+
+def collector() -> SpanCollector:
+    return _collector
+
+
+def set_process(name: str) -> None:
+    """Tag every span this process emits (shard name); feeds the
+    cross-process view in merged traces."""
+    global _process_name
+    _process_name = name
+
+
+def process_name() -> str:
+    return _process_name
+
+
+# ---------------------------------------------------------------------------
+# enable switch + thread-local current span
+# ---------------------------------------------------------------------------
+
+_enabled = False
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def current_span() -> Span | None:
+    return getattr(_tls, "span", None)
+
+
+def current_context() -> SpanContext | None:
+    """Context of the live span, for injection into headers or
+    annotations; None when tracing is off or no span is open."""
+    if not _enabled:
+        return None
+    span = getattr(_tls, "span", None)
+    return span.context() if span is not None else None
+
+
+def current_traceparent() -> str | None:
+    ctx = current_context()
+    return ctx.to_traceparent() if ctx is not None else None
+
+
+class _NullCtx:
+    """The disabled fast path: one shared instance, no allocation."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return _NULL_SPAN
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def set_attr(self, key, value):
+        pass
+
+    def context(self):
+        return None
+
+    def to_traceparent(self):
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+_NULL_CTX = _NullCtx()
+
+
+class _SpanCtx:
+    """Context manager for one live span: pushes itself as the
+    thread-local current on enter, restores + collects on exit."""
+
+    __slots__ = ("span", "_prev")
+
+    def __init__(self, span: Span):
+        self.span = span
+        self._prev = None
+
+    def __enter__(self) -> Span:
+        self._prev = getattr(_tls, "span", None)
+        _tls.span = self.span
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb):
+        _tls.span = self._prev
+        self.span.end = time.time()
+        if exc_type is not None:
+            self.span.attrs["error"] = f"{exc_type.__name__}: {exc}"
+        _collector.add(self.span)
+        return False
+
+
+def start_span(name: str, *, kind: str = "internal",
+               parent: SpanContext | Span | str | None = None,
+               root: bool = False, attrs: dict | None = None):
+    """Open a span as a context manager.
+
+    ``parent`` overrides the thread-local current span: pass a
+    SpanContext (remote hop), a Span, or a raw traceparent string
+    (annotation payload). ``root=True`` forces a fresh trace even if a
+    current span exists. Disabled tracing returns a shared no-op after
+    a single boolean check.
+    """
+    if not _enabled:
+        return _NULL_CTX
+    if isinstance(parent, str):
+        parent = parse_traceparent(parent)
+    if parent is None and not root:
+        parent = getattr(_tls, "span", None)
+    if parent is not None and not root:
+        trace_id = parent.trace_id
+        parent_id = parent.span_id
+    else:
+        trace_id = new_trace_id()
+        parent_id = None
+    span = Span(name, trace_id=trace_id, span_id=new_span_id(),
+                parent_id=parent_id, kind=kind, attrs=attrs,
+                process=_process_name)
+    return _SpanCtx(span)
+
+
+def start_span_if_active(name: str, *, kind: str = "internal",
+                         attrs: dict | None = None):
+    """Child span only when a trace is already in flight on this
+    thread — internal hops (admission, reconcile phases, scheduling)
+    use this so background work with no causal origin doesn't mint
+    orphan root traces."""
+    if not _enabled or getattr(_tls, "span", None) is None:
+        return _NULL_CTX
+    return start_span(name, kind=kind, attrs=attrs)
+
+
+def record_span(name: str, *, start: float, end: float,
+                parent: SpanContext | Span | str | None = None,
+                kind: str = "internal",
+                attrs: dict | None = None) -> SpanContext | None:
+    """Retroactively record a span whose interval was measured
+    elsewhere (e.g. the serving drain thread stamping submit→done on
+    completion). Returns the new span's context for chaining."""
+    if not _enabled:
+        return None
+    if isinstance(parent, str):
+        parent = parse_traceparent(parent)
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    else:
+        trace_id, parent_id = new_trace_id(), None
+    span = Span(name, trace_id=trace_id, span_id=new_span_id(),
+                parent_id=parent_id, kind=kind, start=start,
+                attrs=attrs, process=_process_name)
+    span.end = end
+    _collector.add(span)
+    return span.context()
+
+
+class attach:
+    """Adopt a remote context as the thread-local current WITHOUT
+    opening a span — the workqueue worker uses this so annotation
+    stamping inside the reconcile inherits the right trace even before
+    the reconcile span opens."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: SpanContext | str | None):
+        if isinstance(ctx, str):
+            ctx = parse_traceparent(ctx)
+        self._ctx = ctx
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "span", None)
+        if self._ctx is not None:
+            # a context is not a Span; wrap it in an uncollected stub
+            # that only exists to parent children
+            _tls.span = Span("(attached)", trace_id=self._ctx.trace_id,
+                             span_id=self._ctx.span_id, parent_id=None)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _tls.span = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
+# annotation plumbing (async causality across watch/workqueue hops)
+# ---------------------------------------------------------------------------
+
+def stamp(obj: dict) -> None:
+    """Write the live context into ``metadata.annotations`` of an
+    object about to be persisted, so watch consumers can resume the
+    trace. No-op when tracing is off or no span is open."""
+    if not _enabled:
+        return
+    tp = current_traceparent()
+    if tp is None:
+        return
+    md = obj.setdefault("metadata", {})
+    ann = md.get("annotations")
+    if ann is None:
+        ann = md["annotations"] = {}
+    # first cause wins: an object stamped at creation keeps that
+    # context for life — later writers extend the SAME trace via their
+    # own spans, they don't rewrite history
+    ann.setdefault(TRACE_ANNOTATION, tp)
+
+
+def context_of(obj: dict | None) -> SpanContext | None:
+    """Read a stamped context back off an object (watch event)."""
+    if not obj:
+        return None
+    ann = (obj.get("metadata") or {}).get("annotations") or {}
+    return parse_traceparent(ann.get(TRACE_ANNOTATION))
+
+
+# ---------------------------------------------------------------------------
+# critical path
+# ---------------------------------------------------------------------------
+
+def critical_path(spans: list[dict]) -> list[dict]:
+    """Reduce one trace's spans to the ordered blocking chain.
+
+    Walks the span tree backwards from the root's end: at each cursor
+    position the blocking span is the deepest descendant still running;
+    the gap back to that child's start is charged to the parent as
+    SELF time. Segments partition the root interval exactly (children
+    are clipped to their parent), so ``sum(self_ms) == root duration``
+    — the property the conformance artifact asserts against measured
+    wallclock.
+
+    Returns hops ordered by first appearance on the path:
+    ``{name, span_id, process, kind, self_ms, start, end}``.
+    """
+    closed = [dict(s) for s in spans if s.get("end") is not None]
+    if not closed:
+        return []
+    by_id = {s["span_id"]: s for s in closed}
+    children: dict[str, list[dict]] = {}
+    roots = []
+    for s in closed:
+        pid = s.get("parent_id")
+        if pid and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    root = min(roots, key=lambda s: s["start"])
+
+    # (span, seg_start, seg_end) self-time segments, collected by a
+    # backwards walk; recursion depth = tree depth (dozens, not 1e4)
+    segments: list[tuple[dict, float, float]] = []
+
+    def walk(span: dict, start_cut: float, end_cut: float) -> None:
+        cursor = end_cut
+        kids = [c for c in children.get(span["span_id"], [])
+                if c["start"] < end_cut and c["end"] > start_cut]
+        while kids and cursor > start_cut:
+            live = [c for c in kids if c["start"] < cursor]
+            if not live:
+                break
+            # the child whose (clipped) end reaches closest to cursor
+            # is what the parent was blocked on
+            c = max(live, key=lambda c: min(c["end"], cursor))
+            c_end = min(c["end"], cursor)
+            c_start = max(c["start"], start_cut)
+            if c_end < cursor:
+                segments.append((span, c_end, cursor))
+            walk(c, c_start, c_end)
+            cursor = c_start
+            kids.remove(c)
+        if cursor > start_cut:
+            segments.append((span, start_cut, cursor))
+
+    walk(root, root["start"], root["end"])
+
+    # aggregate per span, ordered by earliest segment on the path
+    agg: dict[str, dict] = {}
+    for span, s0, s1 in segments:
+        hop = agg.get(span["span_id"])
+        if hop is None:
+            hop = agg[span["span_id"]] = {
+                "name": span["name"],
+                "span_id": span["span_id"],
+                "process": span.get("process", ""),
+                "kind": span.get("kind", "internal"),
+                "self_ms": 0.0,
+                "start": span["start"],
+                "end": span["end"],
+                "_first": s0,
+            }
+        hop["self_ms"] += (s1 - s0) * 1e3
+        hop["_first"] = min(hop["_first"], s0)
+    hops = sorted(agg.values(), key=lambda h: h["_first"])
+    for h in hops:
+        del h["_first"]
+        h["self_ms"] = round(h["self_ms"], 3)
+    return hops
+
+
+def merge_spans(*span_lists: list[dict]) -> list[dict]:
+    """Union span lists from several collectors (processes), deduped
+    on (trace_id, span_id) — the cross-shard merge primitive."""
+    out: dict[tuple[str, str], dict] = {}
+    for spans in span_lists:
+        for d in spans or []:
+            out.setdefault((d["trace_id"], d["span_id"]), d)
+    return sorted(out.values(), key=lambda d: d["start"])

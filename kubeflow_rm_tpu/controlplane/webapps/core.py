@@ -41,6 +41,7 @@ from werkzeug.wrappers import Request, Response
 from kubeflow_rm_tpu.controlplane.apiserver import (
     AdmissionDenied, AlreadyExists, APIServer, Invalid, NotFound,
 )
+from kubeflow_rm_tpu.controlplane import tracing
 
 log = logging.getLogger("kubeflow_rm_tpu.webapps")
 
@@ -188,6 +189,24 @@ class WebApp:
 
     # ---- WSGI --------------------------------------------------------
     def __call__(self, environ, start_response):
+        # server-span boundary for context-bearing requests: a client
+        # that sends ``traceparent`` (the conformance harness around a
+        # notebook POST) gets the whole handler — auth, CSRF, apiserver
+        # writes, downstream kube calls — recorded as one server hop of
+        # ITS trace. Header-less traffic takes the plain path.
+        if tracing.enabled():
+            parent = tracing.parse_traceparent(
+                environ.get("HTTP_TRACEPARENT"))
+            if parent is not None:
+                with tracing.start_span(
+                        f"{environ.get('REQUEST_METHOD', 'GET')} "
+                        f"{environ.get('PATH_INFO', '/')}",
+                        kind="server", parent=parent,
+                        attrs={"component": self.name}):
+                    return self._call_inner(environ, start_response)
+        return self._call_inner(environ, start_response)
+
+    def _call_inner(self, environ, start_response):
         req = Request(environ)
         try:
             endpoint, args = self._map.bind_to_environ(environ).match()

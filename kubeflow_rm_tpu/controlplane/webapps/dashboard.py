@@ -88,7 +88,21 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         """TPU fleet utilization: the dashboard's resource numbers
         (reference queries Prometheus/Stackdriver behind a factory —
         ``metrics_service_factory.ts``; the backend here is pluggable
-        the same way, defaulting to live inventory)."""
+        the same way, defaulting to live inventory).
+
+        ``?profile=cpu`` (opt-in, gated on KFRM_ENABLE_PROFILING=1)
+        wraps the snapshot in cProfile and returns the stats table —
+        the pprof-style "why is this scrape slow" hook."""
+        if req.args.get("profile") == "cpu":
+            import os
+            if os.environ.get("KFRM_ENABLE_PROFILING") != "1":
+                from werkzeug.exceptions import Forbidden
+                raise Forbidden(
+                    "profiling is disabled; set KFRM_ENABLE_PROFILING=1")
+            from kubeflow_rm_tpu.utils import profiling
+            with profiling.profile_wsgi() as table:
+                snap = metrics_svc.snapshot()
+            return {"snapshot": snap, "profile": table.getvalue()}
         return metrics_svc.snapshot()
 
     @app.route("/api/metrics/history")
@@ -98,6 +112,79 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         ring of snapshots sampled in-process)."""
         return {"interval_s": history.interval_s,
                 "series": history.series()}
+
+    # ---- distributed traces -----------------------------------------
+    def _merged_spans():
+        """This process's collector merged with every shard's
+        ``/debug/traces`` export (a sharded api hops cross-process, so
+        one trace's spans are scattered over the shard collectors)."""
+        from kubeflow_rm_tpu.controlplane import tracing
+        local = tracing.collector()
+        span_lists = [local.spans()]
+        slow = list(local.slow_traces())
+        shard_urls = getattr(api, "shard_urls", None) or {}
+        if shard_urls:
+            import json as _json
+            import urllib.request
+            for url in shard_urls.values():
+                try:
+                    with urllib.request.urlopen(
+                            url.rstrip("/") + "/debug/traces",
+                            timeout=2.0) as resp:
+                        payload = _json.loads(resp.read().decode())
+                except Exception:  # noqa: BLE001 - shard may be down
+                    continue
+                span_lists.append(payload.get("spans") or [])
+                slow.extend(payload.get("slow") or [])
+        return tracing.merge_spans(*span_lists), slow
+
+    @app.route("/api/traces")
+    def list_traces(req):
+        """Slow-trace index: tail-sampled root traces across every
+        shard, slowest first, with span counts and the processes each
+        trace crossed."""
+        from kubeflow_rm_tpu.controlplane import tracing
+        spans, slow = _merged_spans()
+        by_trace: dict[str, list] = {}
+        for s in spans:
+            by_trace.setdefault(s["trace_id"], []).append(s)
+        slow_index = []
+        seen = set()
+        for t in sorted(slow, key=lambda t: -(t.get("duration_ms") or 0)):
+            if t["trace_id"] in seen:
+                continue
+            seen.add(t["trace_id"])
+            merged = tracing.merge_spans(
+                t.get("spans") or [], by_trace.get(t["trace_id"], []))
+            slow_index.append({
+                "trace_id": t["trace_id"],
+                "duration_ms": t.get("duration_ms"),
+                "spans": len(merged),
+                "processes": sorted({s.get("process") or ""
+                                     for s in merged}),
+            })
+        return {"enabled": tracing.enabled(),
+                "traces": len(by_trace),
+                "slow": slow_index}
+
+    @app.route("/api/traces/<trace_id>")
+    def get_trace(req, trace_id):
+        """One whole trace — spans merged across shards — plus its
+        critical path (the ordered blocking chain with per-hop self
+        time; self_ms sums to the root span's duration)."""
+        from kubeflow_rm_tpu.controlplane import tracing
+        spans, slow = _merged_spans()
+        mine = [s for s in spans if s["trace_id"] == trace_id]
+        for t in slow:
+            if t["trace_id"] == trace_id:
+                mine = tracing.merge_spans(mine, t.get("spans") or [])
+        if not mine:
+            from werkzeug.exceptions import NotFound as HTTPNotFound
+            raise HTTPNotFound(f"no spans for trace {trace_id!r}")
+        mine.sort(key=lambda s: s["start"])
+        return {"trace_id": trace_id,
+                "spans": mine,
+                "critical_path": tracing.critical_path(mine)}
 
     # ---- api_workgroup.ts surface -----------------------------------
     @app.route("/api/workgroup/exists")

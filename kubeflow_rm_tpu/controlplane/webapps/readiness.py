@@ -30,7 +30,7 @@ import threading
 import time
 from typing import Callable
 
-from kubeflow_rm_tpu.controlplane import metrics
+from kubeflow_rm_tpu.controlplane import metrics, tracing
 
 # a wedged watch degrades to this guard tick instead of hanging waiters
 _GUARD_TICK_S = 1.0
@@ -117,6 +117,21 @@ class ReadinessHub:
         Returns ``(obj, changed)`` where ``obj`` is the last fetched
         state and ``changed`` says whether the predicate was met.
         """
+        # the readiness wake is the LAST hop of a provision trace: the
+        # span covers park -> watch-event wake -> predicate satisfied,
+        # so critical-path attribution separates "waiting on the
+        # controller" from handler overhead
+        with tracing.start_span_if_active(
+                "readiness.wait",
+                attrs={"namespace": namespace, "name": name}) as sp:
+            obj, changed = self._wait_inner(namespace, name, timeout_s,
+                                            fetch, satisfied)
+            sp.set_attr("satisfied", changed)
+            return obj, changed
+
+    def _wait_inner(self, namespace: str, name: str, timeout_s: float,
+                    fetch: Callable[[], dict | None],
+                    satisfied: Callable[[dict | None], bool]):
         deadline = time.monotonic() + max(0.0, timeout_s)
         key = (namespace, name)
         t_start = time.perf_counter()

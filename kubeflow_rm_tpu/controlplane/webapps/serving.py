@@ -47,6 +47,7 @@ import time
 from dataclasses import dataclass
 
 from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+from kubeflow_rm_tpu.controlplane import tracing
 from kubeflow_rm_tpu.controlplane.deploy.kubeclient import TokenBucket
 
 
@@ -66,14 +67,20 @@ class _Pending:
     """A request in flight: the HTTP thread parks on ``event`` while
     the drain thread decodes."""
 
-    __slots__ = ("req", "tenant", "event", "t_submit", "t_done")
+    __slots__ = ("req", "tenant", "event", "t_submit", "t_done",
+                 "trace", "t_submit_epoch")
 
-    def __init__(self, req, tenant):
+    def __init__(self, req, tenant, trace=None):
         self.req = req
         self.tenant = tenant
         self.event = threading.Event()
         self.t_submit = time.monotonic()
         self.t_done = None
+        # traceparent of the admitting request, if it carried one —
+        # the drain thread stamps the decode span against it; epoch
+        # twin of t_submit because spans use wall time
+        self.trace = trace
+        self.t_submit_epoch = time.time()
 
 
 class ServingGateway:
@@ -102,6 +109,10 @@ class ServingGateway:
         # sliding per-tenant latency windows for p95 reporting, plus
         # the EMA the SLO projection sheds on
         self._lat_windows: dict[str, list[float]] = {}
+        # per-tenant slowest traced request — the exemplar id reported
+        # next to the latency summary so "p95 is bad" links straight
+        # to a trace you can pull from /api/traces/<id>
+        self._exemplars: dict[str, dict] = {}
         self._ema_ms: float | None = None
         self.shed_counts: dict[str, int] = {}
         self._stop = threading.Event()
@@ -137,31 +148,39 @@ class ServingGateway:
         """Admit or shed. Returns (pending, None) on admit,
         (None, reason) on shed — reason in rate|tokens|queue|slo."""
         pol = self._policy(tenant)
-        if self.admission:
-            rate, budget = self._buckets(tenant)
-            if not rate.try_acquire(1.0):
-                self._shed(tenant, "rate")
-                return None, "rate"
-            if not budget.try_acquire(float(max_new_tokens)):
-                self._shed(tenant, "tokens")
-                return None, "tokens"
-        with self._lock:
-            depth = self.engine.queue_depth
-            if depth >= self.max_queue:
-                self._shed(tenant, "queue")
-                return None, "queue"
-            if self.admission and self._ema_ms is not None:
-                projected = (depth / self.engine.slots + 1.0) \
-                    * self._ema_ms
-                if projected > pol.slo_p95_ms:
-                    self._shed(tenant, "slo")
-                    return None, "slo"
-            req = self.engine.submit(prompt,
-                                     max_new_tokens=max_new_tokens,
-                                     eos_id=eos_id)
-            pending = _Pending(req, tenant)
-            self._pending.append(pending)
-            cp_metrics.SERVING_QUEUE_DEPTH.set(self.engine.queue_depth)
+        trace = tracing.current_traceparent()
+        with tracing.start_span_if_active(
+                "serving.admit", attrs={"tenant": tenant}) as sp:
+            if self.admission:
+                rate, budget = self._buckets(tenant)
+                if not rate.try_acquire(1.0):
+                    self._shed(tenant, "rate")
+                    sp.set_attr("shed", "rate")
+                    return None, "rate"
+                if not budget.try_acquire(float(max_new_tokens)):
+                    self._shed(tenant, "tokens")
+                    sp.set_attr("shed", "tokens")
+                    return None, "tokens"
+            with self._lock:
+                depth = self.engine.queue_depth
+                if depth >= self.max_queue:
+                    self._shed(tenant, "queue")
+                    sp.set_attr("shed", "queue")
+                    return None, "queue"
+                if self.admission and self._ema_ms is not None:
+                    projected = (depth / self.engine.slots + 1.0) \
+                        * self._ema_ms
+                    if projected > pol.slo_p95_ms:
+                        self._shed(tenant, "slo")
+                        sp.set_attr("shed", "slo")
+                        return None, "slo"
+                req = self.engine.submit(prompt,
+                                         max_new_tokens=max_new_tokens,
+                                         eos_id=eos_id)
+                pending = _Pending(req, tenant, trace=trace)
+                self._pending.append(pending)
+                cp_metrics.SERVING_QUEUE_DEPTH.set(
+                    self.engine.queue_depth)
         return pending, None
 
     def wait(self, pending: _Pending, timeout_s: float = 300.0
@@ -211,6 +230,23 @@ class ServingGateway:
                 del window[:-256]
                 self._ema_ms = (lat_ms if self._ema_ms is None else
                                 0.8 * self._ema_ms + 0.2 * lat_ms)
+                if p.trace is not None:
+                    # retroactive span: the interval was measured here
+                    # on the drain thread, parented on the admitting
+                    # request so prefill+decode joins its trace
+                    tracing.record_span(
+                        "serving.decode",
+                        start=p.t_submit_epoch, end=time.time(),
+                        parent=p.trace,
+                        attrs={"tenant": p.tenant,
+                               "tokens": len(p.req.tokens)})
+                    ctx = tracing.parse_traceparent(p.trace)
+                    ex = self._exemplars.get(p.tenant)
+                    if ctx is not None and (ex is None
+                                            or lat_ms > ex["latency_ms"]):
+                        self._exemplars[p.tenant] = {
+                            "trace_id": ctx.trace_id,
+                            "latency_ms": round(lat_ms, 3)}
                 p.event.set()
             if not busy:
                 self._stop.wait(0.001)
@@ -227,11 +263,15 @@ class ServingGateway:
     def tenant_latency(self, tenant: str) -> dict:
         window = sorted(self._lat_windows.get(tenant, []))
         if not window:
-            return {"count": 0, "p50_ms": None, "p95_ms": None}
+            return {"count": 0, "p50_ms": None, "p95_ms": None,
+                    "slowest_trace": self._exemplars.get(tenant)}
         return {
             "count": len(window),
             "p50_ms": window[int(0.50 * (len(window) - 1))],
             "p95_ms": window[int(0.95 * (len(window) - 1))],
+            # exemplar: the slowest TRACED request seen for this tenant
+            # — resolves via GET /api/traces/<trace_id>
+            "slowest_trace": self._exemplars.get(tenant),
         }
 
     def snapshot(self) -> dict:
@@ -273,6 +313,22 @@ def make_serving_app(gateway: ServingGateway, cfg):
                         content_type="application/json")
 
     def app(environ, start_response):
+        # same server-span contract as WebApp: context-bearing requests
+        # join their caller's trace (admission + parked wait happen
+        # inside; the decode span is stamped by the drain thread)
+        if tracing.enabled():
+            parent = tracing.parse_traceparent(
+                environ.get("HTTP_TRACEPARENT"))
+            if parent is not None:
+                with tracing.start_span(
+                        f"{environ.get('REQUEST_METHOD', 'GET')} "
+                        f"{environ.get('PATH_INFO', '/')}",
+                        kind="server", parent=parent,
+                        attrs={"component": "serving"}):
+                    return _app_inner(environ, start_response)
+        return _app_inner(environ, start_response)
+
+    def _app_inner(environ, start_response):
         req = Request(environ)
         try:
             endpoint, _ = urls.bind_to_environ(environ).match()

@@ -51,8 +51,16 @@ class PhaseRecorder:
 
     @staticmethod
     def _pct(vals: list[float], q: float) -> float:
-        i = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
-        return sorted(vals)[i]
+        # linear interpolation between closest ranks (numpy's default
+        # percentile method) — nearest-rank rounding made p95 of a
+        # 20-sample storm report the 18th sample, off by half a rank
+        s = sorted(vals)
+        if len(s) == 1:
+            return s[0]
+        pos = min(max(q, 0.0), 1.0) * (len(s) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(s) - 1)
+        return s[lo] + (s[hi] - s[lo]) * (pos - lo)
 
     def summary(self) -> dict[str, dict]:
         out = {}
@@ -61,6 +69,7 @@ class PhaseRecorder:
                 "count": len(vals),
                 "p50_ms": round(self._pct(vals, 0.5) * 1e3, 1),
                 "p95_ms": round(self._pct(vals, 0.95) * 1e3, 1),
+                "p99_ms": round(self._pct(vals, 0.99) * 1e3, 1),
                 "max_ms": round(max(vals) * 1e3, 1),
             }
         return out

@@ -1,0 +1,175 @@
+"""Observability satellites: PhaseRecorder percentile math,
+controlplane/metrics.py helper coverage, the dashboard's trace
+endpoints, and the opt-in ``?profile=cpu`` WSGI profiler hook."""
+
+import json
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import metrics, tracing
+from kubeflow_rm_tpu.utils.profiling import PhaseRecorder, profile_wsgi
+
+USER = "alice@corp.com"
+
+
+# ---- PhaseRecorder percentiles ---------------------------------------
+
+def test_pct_linear_interpolation_between_ranks():
+    # 1..10: p50 must interpolate (5+6)/2, not snap to a sample
+    vals = [float(v) for v in range(1, 11)]
+    assert PhaseRecorder._pct(vals, 0.5) == pytest.approx(5.5)
+    assert PhaseRecorder._pct(vals, 0.95) == pytest.approx(9.55)
+    assert PhaseRecorder._pct(vals, 0.0) == 1.0
+    assert PhaseRecorder._pct(vals, 1.0) == 10.0
+    # order-insensitive
+    assert PhaseRecorder._pct(list(reversed(vals)), 0.5) == \
+        pytest.approx(5.5)
+
+
+def test_pct_single_sample_and_clamping():
+    assert PhaseRecorder._pct([7.0], 0.99) == 7.0
+    assert PhaseRecorder._pct([1.0, 3.0], 2.0) == 3.0   # q clamped
+    assert PhaseRecorder._pct([1.0, 3.0], -1.0) == 1.0
+
+
+def test_pct_matches_numpy_default_method():
+    np = pytest.importorskip("numpy")
+    vals = [0.3, 1.7, 0.01, 2.4, 0.9, 5.5, 0.02]
+    for q in (0.5, 0.9, 0.95, 0.99):
+        assert PhaseRecorder._pct(vals, q) == pytest.approx(
+            float(np.percentile(vals, q * 100)))
+
+
+def test_summary_reports_p99_and_merge():
+    rec = PhaseRecorder()
+    for ms in range(1, 101):            # 1..100 ms
+        rec.record("phase", ms / 1e3)
+    other = PhaseRecorder()
+    other.record("other", 0.5)
+    rec.merge(other)
+    summary = rec.summary()
+    assert set(summary) == {"phase", "other"}
+    s = summary["phase"]
+    assert s["count"] == 100
+    assert s["p50_ms"] == pytest.approx(50.5, abs=0.1)
+    assert s["p99_ms"] == pytest.approx(99.0, abs=0.1)
+    assert s["p99_ms"] >= s["p95_ms"] >= s["p50_ms"]
+    assert s["max_ms"] == pytest.approx(100.0, abs=0.1)
+
+
+# ---- metrics.py helpers ----------------------------------------------
+
+def test_registry_value_sums_and_filters_labels():
+    metrics.SCHEDULE_LATENCY_SECONDS.labels(result="bound").observe(0.1)
+    metrics.SCHEDULE_LATENCY_SECONDS.labels(
+        result="unschedulable").observe(0.2)
+    bound = metrics.registry_value(
+        "schedule_latency_seconds_count", {"result": "bound"})
+    both = metrics.registry_value("schedule_latency_seconds_count")
+    assert bound >= 1
+    assert both >= bound + 1
+    assert metrics.registry_value("no_such_sample") == 0.0
+    assert metrics.registry_value(
+        "schedule_latency_seconds_count", {"result": "nope"}) == 0.0
+
+
+def test_scrape_is_prometheus_exposition_text():
+    metrics.NOTEBOOK_RUNNING.set(3)
+    text = metrics.scrape().decode()
+    assert "# HELP notebook_running" in text
+    assert "# TYPE notebook_running gauge" in text
+    assert "notebook_running 3.0" in text
+
+
+def test_set_shard_round_trips_label():
+    prev = metrics.shard_label()
+    try:
+        metrics.set_shard("shard-9")
+        assert metrics.shard_label() == "shard-9"
+    finally:
+        metrics.set_shard(prev)
+
+
+# ---- profile_wsgi ----------------------------------------------------
+
+def test_profile_wsgi_captures_stats_table():
+    with profile_wsgi(limit=5) as table:
+        sum(i * i for i in range(1000))
+        assert table.getvalue() == ""   # written only on exit
+    out = table.getvalue()
+    assert "function calls" in out
+    assert "cumulative" in out
+
+
+# ---- dashboard trace endpoints + profiling hook ----------------------
+
+@pytest.fixture
+def dash():
+    from kubeflow_rm_tpu.controlplane import make_control_plane
+    from kubeflow_rm_tpu.controlplane.webapps import dashboard
+    api, mgr = make_control_plane()
+    app = dashboard.create_app(api)
+    return api, mgr, app.test_client(user=USER)
+
+
+@pytest.fixture
+def traced():
+    tracing.collector().clear()
+    tracing.set_enabled(True)
+    yield tracing.collector()
+    tracing.set_enabled(False)
+    tracing.collector().clear()
+
+
+def test_api_traces_disabled_is_empty(dash):
+    _, _, client = dash
+    resp = client.get("/api/traces")
+    body = json.loads(resp.get_data())
+    assert body["enabled"] is False
+    assert body["slow"] == []
+
+
+def test_api_traces_serves_slow_index_and_critical_path(dash, traced):
+    _, _, client = dash
+    tid = "d" * 32
+    # hand-recorded slow trace: root + one child
+    root = tracing.Span("provision", trace_id=tid, span_id="r" * 16,
+                        parent_id=None, start=100.0)
+    child = tracing.Span("reconcile", trace_id=tid, span_id="c" * 16,
+                         parent_id="r" * 16, start=100.1)
+    child.end = 100.4
+    traced.add(child)
+    root.end = 100.5                    # 400ms >= slow threshold
+    traced.add(root)
+
+    body = json.loads(client.get("/api/traces").get_data())
+    assert body["enabled"] is True
+    (slow,) = body["slow"]
+    assert slow["trace_id"] == tid
+    assert slow["duration_ms"] == pytest.approx(500, abs=1)
+    assert slow["spans"] == 2
+
+    detail = json.loads(client.get(f"/api/traces/{tid}").get_data())
+    assert [s["name"] for s in detail["spans"]] == [
+        "provision", "reconcile"]
+    path = detail["critical_path"]
+    assert [h["name"] for h in path] == ["provision", "reconcile"]
+    assert sum(h["self_ms"] for h in path) == pytest.approx(
+        500, abs=1)
+
+    assert client.get("/api/traces/" + "0" * 32).status_code == 404
+
+
+def test_profile_cpu_gated_on_env(dash, monkeypatch):
+    _, _, client = dash
+    monkeypatch.delenv("KFRM_ENABLE_PROFILING", raising=False)
+    assert client.get("/api/metrics?profile=cpu").status_code == 403
+    # plain snapshot path unaffected
+    assert client.get("/api/metrics").status_code == 200
+
+    monkeypatch.setenv("KFRM_ENABLE_PROFILING", "1")
+    resp = client.get("/api/metrics?profile=cpu")
+    assert resp.status_code == 200
+    body = json.loads(resp.get_data())
+    assert "snapshot" in body
+    assert "function calls" in body["profile"]

@@ -1,0 +1,398 @@
+"""Distributed tracing (controlplane/tracing.py): context propagation
+across HTTP headers, annotations, and the workqueue; the bounded span
+collector with tail-sampled slow-trace retention; cross-shard span
+merging; and critical-path extraction.
+
+Tracing is globally OFF by default — every test that turns it on goes
+through the ``traced`` fixture so the switch and the process-wide
+collector are restored for the rest of the suite.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import tracing
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, make_object
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.tracing import Span, SpanCollector
+
+
+@pytest.fixture
+def traced():
+    tracing.collector().clear()
+    tracing.set_enabled(True)
+    tracing.set_process("test")
+    yield tracing.collector()
+    tracing.set_enabled(False)
+    tracing.set_process("")
+    tracing.collector().clear()
+
+
+def _mkspan(name, *, trace_id, span_id, parent_id=None, start=0.0,
+            end=1.0, process=""):
+    s = Span(name, trace_id=trace_id, span_id=span_id,
+             parent_id=parent_id, start=start, process=process)
+    s.end = end
+    return s
+
+
+# ---- traceparent parsing ---------------------------------------------
+
+def test_parse_traceparent_roundtrip():
+    ctx = tracing.SpanContext(tracing.new_trace_id(),
+                              tracing.new_span_id())
+    back = tracing.parse_traceparent(ctx.to_traceparent())
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-short-01",
+    "00-" + "z" * 32 + "-" + "0" * 16 + "-01",      # non-hex
+    "00-" + "0" * 32 + "-" + "0" * 16,              # 3 parts
+    "00-" + "0" * 31 + "-" + "0" * 16 + "-01",      # 31-char trace id
+])
+def test_parse_traceparent_rejects_malformed(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+# ---- disabled fast path ----------------------------------------------
+
+def test_disabled_path_is_shared_noop():
+    assert not tracing.enabled()
+    # identity, not equality: the disabled path allocates nothing
+    assert tracing.start_span("x") is tracing.start_span("y")
+    assert tracing.start_span_if_active("z") is tracing.start_span("x")
+    with tracing.start_span("x") as sp:
+        sp.set_attr("k", "v")           # absorbed silently
+        assert sp.to_traceparent() is None
+        assert sp.context() is None
+    assert tracing.current_context() is None
+    assert tracing.record_span("r", start=0, end=1) is None
+    obj = {"metadata": {}}
+    tracing.stamp(obj)
+    assert "annotations" not in obj["metadata"]
+    assert not tracing.collector().spans()
+
+
+# ---- thread-local parenting ------------------------------------------
+
+def test_nested_spans_parent_on_thread_local(traced):
+    with tracing.start_span("outer") as outer:
+        with tracing.start_span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            assert tracing.current_span() is inner
+        assert tracing.current_span() is outer
+    assert tracing.current_span() is None
+    names = {s["name"]: s for s in traced.spans()}
+    assert set(names) == {"outer", "inner"}
+    assert names["outer"]["parent_id"] is None
+    assert names["outer"]["process"] == "test"
+
+
+def test_root_forces_fresh_trace(traced):
+    with tracing.start_span("outer") as outer:
+        with tracing.start_span("fresh", root=True) as fresh:
+            assert fresh.trace_id != outer.trace_id
+            assert fresh.parent_id is None
+
+
+def test_start_span_if_active_requires_live_span(traced):
+    # no trace in flight: internal hops must not mint orphan roots
+    assert tracing.start_span_if_active("hop") is tracing._NULL_CTX
+    with tracing.start_span("root") as root:
+        with tracing.start_span_if_active("hop") as hop:
+            assert hop.trace_id == root.trace_id
+            assert hop.parent_id == root.span_id
+
+
+def test_explicit_parent_overrides_thread_local(traced):
+    remote = tracing.SpanContext(tracing.new_trace_id(),
+                                 tracing.new_span_id())
+    with tracing.start_span("local"):
+        with tracing.start_span("srv", parent=remote) as srv:
+            assert srv.trace_id == remote.trace_id
+            assert srv.parent_id == remote.span_id
+        # raw traceparent strings (annotation payloads) also accepted
+        with tracing.start_span("srv2",
+                                parent=remote.to_traceparent()) as srv2:
+            assert srv2.trace_id == remote.trace_id
+
+
+def test_span_error_recorded_on_exception(traced):
+    with pytest.raises(ValueError):
+        with tracing.start_span("boom"):
+            raise ValueError("bad")
+    (span,) = traced.spans()
+    assert span["attrs"]["error"] == "ValueError: bad"
+    assert span["end"] is not None
+
+
+def test_record_span_retroactive(traced):
+    parent = tracing.SpanContext(tracing.new_trace_id(),
+                                 tracing.new_span_id())
+    t0 = time.time() - 0.1
+    ctx = tracing.record_span("decode", start=t0, end=t0 + 0.05,
+                              parent=parent, attrs={"tokens": 3})
+    assert ctx.trace_id == parent.trace_id
+    (span,) = traced.spans()
+    assert span["parent_id"] == parent.span_id
+    assert span["duration_ms"] == pytest.approx(50, abs=1)
+
+
+# ---- annotation plumbing (async causality) ---------------------------
+
+def test_stamp_and_context_of_roundtrip(traced):
+    obj = make_object("v1", "ConfigMap", "c", "ns")
+    with tracing.start_span("client") as client:
+        tracing.stamp(obj)
+    ctx = tracing.context_of(obj)
+    assert ctx.trace_id == client.trace_id
+    assert ctx.span_id == client.span_id
+
+
+def test_stamp_first_cause_wins(traced):
+    obj = make_object("v1", "ConfigMap", "c", "ns")
+    with tracing.start_span("creator"):
+        tracing.stamp(obj)
+    first = obj["metadata"]["annotations"][tracing.TRACE_ANNOTATION]
+    with tracing.start_span("updater", root=True):
+        tracing.stamp(obj)  # later writers must not rewrite history
+    assert obj["metadata"]["annotations"][
+        tracing.TRACE_ANNOTATION] == first
+
+
+def test_stamp_noop_without_live_span(traced):
+    obj = make_object("v1", "ConfigMap", "c", "ns")
+    tracing.stamp(obj)
+    assert tracing.context_of(obj) is None
+
+
+def test_attach_adopts_remote_context_without_collecting(traced):
+    remote = tracing.SpanContext(tracing.new_trace_id(),
+                                 tracing.new_span_id())
+    with tracing.attach(remote):
+        with tracing.start_span("child") as child:
+            assert child.trace_id == remote.trace_id
+            assert child.parent_id == remote.span_id
+    assert tracing.current_span() is None
+    # only the child landed; the attach stub is never collected
+    assert [s["name"] for s in traced.spans()] == ["child"]
+    # None context (unstamped object) attaches as a no-op
+    with tracing.attach(None):
+        assert tracing.current_span() is None
+
+
+def test_apiserver_create_stamps_live_context(traced):
+    api = APIServer()
+    api.ensure_namespace("ns")
+    with tracing.start_span("post") as post:
+        api.create(make_object("v1", "ConfigMap", "c", "ns"))
+    stored = api.get("ConfigMap", "c", "ns")
+    assert tracing.context_of(stored).trace_id == post.trace_id
+    # the caller's dict was deep-copied before stamping: no mutation
+    # visible outside the store would be fine either way, but the
+    # STORED copy must carry the annotation
+
+
+# ---- HTTP header propagation (kubeclient -> restserver) --------------
+
+def test_http_hop_stays_one_trace(traced):
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+    )
+    from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+
+    api = APIServer()
+    api.ensure_namespace("ns")
+    rest = RestServer(api)
+    rest.start()
+    try:
+        kapi = KubeAPIServer(rest.url)
+        with tracing.start_span("client-op") as client:
+            kapi.create(make_object("v1", "ConfigMap", "c", "ns"))
+        spans = traced.spans()
+        server = [s for s in spans if s["kind"] == "server"]
+        assert len(server) == 1, spans
+        assert server[0]["trace_id"] == client.trace_id
+        assert server[0]["name"].startswith("POST ")
+        # the object persisted through the hop carries the SAME trace
+        stored = api.get("ConfigMap", "c", "ns")
+        assert tracing.context_of(stored).trace_id == client.trace_id
+        # context-free requests (informer lists, scrapes) get no span
+        before = len(traced.spans())
+        kapi.list("ConfigMap", "ns")
+        assert len([s for s in traced.spans()
+                    if s["kind"] == "server"]) == 1, \
+            "traceparent-less request minted a server span"
+        del before
+    finally:
+        rest.stop()
+
+
+# ---- workqueue propagation (watch -> queue -> reconcile) -------------
+
+def test_workqueue_carries_trace_into_reconcile(traced):
+    from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+        DeploymentController,
+    )
+    from kubeflow_rm_tpu.controlplane.runtime import Manager
+
+    api = APIServer()
+    api.ensure_namespace("ns")
+    mgr = Manager(api)
+    mgr.add(DeploymentController(auto_ready=True))
+    deploy = make_object("apps/v1", "Deployment", "d", "ns")
+    deploy["spec"] = {"replicas": 1, "template": {"spec": {
+        "containers": [{"name": "web", "image": "img"}]}}}
+    with tracing.start_span("post-deploy") as post:
+        api.create(deploy)
+    mgr.run_until_idle()
+    assert deep_get(api.get("Pod", "d-0", "ns"),
+                    "status", "phase") == "Running"
+    spans = traced.spans()
+    recon = [s for s in spans
+             if s["name"] == "reconcile DeploymentController"]
+    assert recon, [s["name"] for s in spans]
+    assert all(s["trace_id"] == post.trace_id for s in recon)
+    assert recon[0]["kind"] == "consumer"
+    # the side map consumed every carried context exactly once
+    assert not mgr._trace_ctx
+    # resync reconciles (no carried context) must not open spans
+    n = len([s for s in traced.spans()
+             if s["name"].startswith("reconcile ")])
+    mgr.enqueue_all()
+    mgr.run_until_idle()
+    assert len([s for s in traced.spans()
+                if s["name"].startswith("reconcile ")]) == n
+
+
+# ---- collector: ring + slow retention --------------------------------
+
+def test_ring_eviction_keeps_newest_and_counts_drops():
+    col = SpanCollector(capacity=4)
+    for i in range(6):
+        col.add(_mkspan(f"s{i}", trace_id="t" * 32,
+                        span_id=f"{i:016d}", parent_id="x",
+                        start=i, end=i + 0.5))
+    got = sorted(s["name"] for s in col.spans())
+    assert got == ["s2", "s3", "s4", "s5"]
+    assert col.dropped == 2
+    assert col.added == 6
+    col.clear()
+    assert not col.spans() and col.dropped == 0
+
+
+def test_slow_trace_retention_survives_ring_eviction():
+    col = SpanCollector(capacity=4, slow_threshold_s=0.05, slow_keep=2)
+    tid = "a" * 32
+    col.add(_mkspan("child", trace_id=tid, span_id="c" * 16,
+                    parent_id="r" * 16, start=0.01, end=0.09))
+    # root closes slow -> whole trace copied aside at that instant
+    col.add(_mkspan("root", trace_id=tid, span_id="r" * 16,
+                    start=0.0, end=0.1))
+    for i in range(8):  # shred the ring
+        col.add(_mkspan(f"noise{i}", trace_id="b" * 32,
+                        span_id=f"{i:016d}", parent_id="x",
+                        start=i, end=i + 0.001))
+    names = {s["name"] for s in col.spans()}
+    assert {"root", "child"} <= names
+    (slow,) = col.slow_traces()
+    assert slow["trace_id"] == tid
+    assert slow["duration_ms"] == pytest.approx(100, abs=1)
+    assert {s["name"] for s in slow["spans"]} == {"root", "child"}
+
+
+def test_slow_store_bounded_keeps_slowest():
+    col = SpanCollector(capacity=64, slow_threshold_s=0.01, slow_keep=2)
+    for i, dur in enumerate([0.02, 0.08, 0.05, 0.03]):
+        col.add(_mkspan(f"r{i}", trace_id=f"{i:032d}",
+                        span_id=f"{i:016d}", start=0.0, end=dur))
+    slow = col.slow_traces()
+    assert [t["duration_ms"] for t in slow] == [80.0, 50.0]
+    # a fast root below the threshold is never retained
+    col.add(_mkspan("fast", trace_id="f" * 32, span_id="f" * 16,
+                    start=0.0, end=0.005))
+    assert len(col.slow_traces()) == 2
+
+
+def test_open_spans_not_retained_as_slow():
+    col = SpanCollector(slow_threshold_s=0.01)
+    s = Span("open", trace_id="c" * 32, span_id="d" * 16,
+             parent_id=None)
+    col.add(s)  # end is None: no duration, no retention decision
+    assert col.slow_traces() == []
+    assert col.spans()[0]["duration_ms"] is None
+
+
+# ---- cross-shard merge -----------------------------------------------
+
+def test_merge_spans_dedupes_across_processes():
+    tid = "e" * 32
+    a = _mkspan("client", trace_id=tid, span_id="1" * 16,
+                start=0.0, end=1.0, process="harness").to_dict()
+    b = _mkspan("server", trace_id=tid, span_id="2" * 16,
+                parent_id="1" * 16, start=0.2, end=0.8,
+                process="shard-0").to_dict()
+    merged = tracing.merge_spans([a, b], [b], [a], [])
+    assert len(merged) == 2
+    assert [s["process"] for s in merged] == ["harness", "shard-0"]
+    assert merged == sorted(merged, key=lambda s: s["start"])
+    assert tracing.merge_spans() == []
+
+
+# ---- critical path ---------------------------------------------------
+
+def test_critical_path_partitions_root_interval():
+    tid = "9" * 32
+    root = _mkspan("root", trace_id=tid, span_id="r" * 16,
+                   start=0.0, end=10.0).to_dict()
+    a = _mkspan("a", trace_id=tid, span_id="a" * 16,
+                parent_id="r" * 16, start=1.0, end=4.0).to_dict()
+    b = _mkspan("b", trace_id=tid, span_id="b" * 16,
+                parent_id="r" * 16, start=5.0, end=9.0).to_dict()
+    g = _mkspan("g", trace_id=tid, span_id="c" * 16,
+                parent_id="b" * 16, start=6.0, end=8.0).to_dict()
+    hops = tracing.critical_path([b, g, root, a])  # order-insensitive
+    assert [h["name"] for h in hops] == ["root", "a", "b", "g"]
+    by_name = {h["name"]: h["self_ms"] for h in hops}
+    # root's self time: [0,1) gap + [4,5) gap + [9,10) tail
+    assert by_name == {"root": 3000.0, "a": 3000.0,
+                       "b": 2000.0, "g": 2000.0}
+    assert sum(by_name.values()) == pytest.approx(10_000.0)
+
+
+def test_critical_path_clips_children_to_parent():
+    tid = "8" * 32
+    root = _mkspan("root", trace_id=tid, span_id="r" * 16,
+                   start=0.0, end=2.0).to_dict()
+    # child outlives the root (async work racing the response): its
+    # contribution is clipped to the root interval
+    late = _mkspan("late", trace_id=tid, span_id="l" * 16,
+                   parent_id="r" * 16, start=1.0, end=5.0).to_dict()
+    hops = tracing.critical_path([root, late])
+    total = sum(h["self_ms"] for h in hops)
+    assert total == pytest.approx(2000.0)
+    assert {h["name"]: h["self_ms"] for h in hops} == {
+        "root": 1000.0, "late": 1000.0}
+
+
+def test_critical_path_ignores_open_spans_and_empty():
+    assert tracing.critical_path([]) == []
+    open_span = Span("open", trace_id="7" * 32, span_id="o" * 16,
+                     parent_id=None).to_dict()
+    assert tracing.critical_path([open_span]) == []
+
+
+def test_critical_path_orphan_parent_treated_as_root():
+    # a span whose parent lives in a collector we failed to scrape
+    # (chaos-killed shard) must not crash the walk; earliest start wins
+    tid = "6" * 32
+    orphan = _mkspan("orphan", trace_id=tid, span_id="o" * 16,
+                     parent_id="missing-parent00", start=0.0,
+                     end=1.0).to_dict()
+    (hop,) = tracing.critical_path([orphan])
+    assert hop["name"] == "orphan"
+    assert hop["self_ms"] == pytest.approx(1000.0)

@@ -42,6 +42,10 @@ Scenarios (each emits ok/skip + wall ms into the JSON artifact):
                        (chips re-gang the waiter), a high-priority
                        resume preempts exactly one victim, the pinned
                        notebook is never chosen
+  multirole            TPUJob gang (learner slice + CPU actors) binds
+                       all-or-nothing; every pod gets role rendezvous
+                       env (TPU vars on chip pods only); an oversize
+                       gang binds ZERO pods
   delete_cascade       deleting the CR garbage-collects every
                        satellite object
   shard_chaos          4 shard PROCESSES (apiserver + WAL + manager
@@ -669,6 +673,72 @@ class Walk:
             runner.stop()
             shutil.rmtree(base, ignore_errors=True)
 
+    def multirole(self):
+        """Podracer-style actor–learner gang over the socket stack: a
+        TPUJob with one learner slice + 4 CPU actors must bind
+        all-or-nothing, every pod carries the role rendezvous env (and
+        TPU vars stay off the chipless actors); an oversize gang must
+        schedule ZERO pods (no rump)."""
+        from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
+
+        actors = 4
+        self.api.create(tj_api.make_tpujob("podracer", NS, roles=[
+            {"name": "learner", "replicas": 1,
+             "tpu": {"acceleratorType": ACCEL}},
+            {"name": "actors", "replicas": actors, "cpu": "500m"},
+        ], image=self.image))
+        self.wait(lambda: ((self.api.try_get("TPUJob", "podracer", NS)
+                            or {}).get("status") or {}).get("phase")
+                  == "Running", what="podracer gang Running")
+
+        def gang_pods(job):
+            return [p for p in self.api.list("Pod", NS)
+                    if (p["metadata"].get("labels") or {}).get(
+                        tj_api.JOB_NAME_LABEL) == job]
+        pods = gang_pods("podracer")
+        assert len(pods) == self.hosts + actors, \
+            f"expected {self.hosts + actors} gang pods, got {len(pods)}"
+        for p in pods:
+            env = {e["name"]: e.get("value")
+                   for c in p["spec"]["containers"]
+                   for e in c.get("env", [])}
+            role = env.get(tj_api.ENV_JOB_ROLE)
+            assert role in ("learner", "actors"), p["metadata"]["name"]
+            assert env.get(tj_api.ENV_JOB_ROLE_INDEX) is not None
+            assert env.get(tj_api.ENV_LEARNER_ADDRESS, "").startswith(
+                "podracer-learner-0."), env.get(
+                    tj_api.ENV_LEARNER_ADDRESS)
+            if role == "learner":
+                assert "TPU_WORKER_ID" in env, \
+                    f"chip pod {p['metadata']['name']} missing TPU env"
+            else:
+                assert "TPU_WORKER_ID" not in env \
+                    and "TPU_WORKER_HOSTNAMES" not in env, \
+                    f"TPU env leaked onto actor {p['metadata']['name']}"
+
+        # all-or-nothing: 3 more slices can't fit next to walk+learner
+        # on a 3-slice fleet — nothing may bind, not even one host
+        self.api.create(tj_api.make_tpujob("podracer-big", NS, roles=[
+            {"name": "learner", "replicas": 3,
+             "tpu": {"acceleratorType": ACCEL}},
+        ], image=self.image))
+        self.wait(lambda: any(
+            e["reason"] == "FailedScheduling"
+            for e in self.api.events_for(
+                self.api.get("TPUJob", "podracer-big", NS))),
+            what="oversize gang FailedScheduling")
+        bound = [p for p in gang_pods("podracer-big")
+                 if deep_get(p, "spec", "nodeName")]
+        assert not bound, f"rump gang of {len(bound)} pods bound"
+
+        for nm in ("podracer-big", "podracer"):
+            self.api.delete("TPUJob", nm, NS)
+        self.wait(lambda: not (gang_pods("podracer")
+                               + gang_pods("podracer-big")),
+                  what="gang pods swept")
+        return {"gang_pods": len(pods), "actors": actors,
+                "learner_hosts": self.hosts}
+
     def delete_cascade(self):
         self.api.delete("Notebook", "walk", NS)
         gone = [("StatefulSet", "walk"), ("Service", "walk"),
@@ -717,6 +787,9 @@ class Walk:
                  skip=None if k else
                  "needs the local backend (suspend controller + "
                  "pod-status control)")
+        self.run("multirole", self.multirole,
+                 skip=None if k else
+                 "needs gang pod-status control (fake kubelet)")
         self.run("delete_cascade", self.delete_cascade)
         self.run("shard_chaos", self.shard_chaos,
                  skip=None if self.ha else
@@ -757,9 +830,12 @@ def local_backend(stop):
         TpuInjectWebhook,
     )
 
+    from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
+
     capi = APIServer()
     capi.register_validator(nb_api.KIND, nb_api.validate)
     capi.register_validator(pd_api.KIND, pd_api.validate)
+    capi.register_validator(tj_api.KIND, tj_api.validate)
     NotebookWebhook(capi).register()
     PodDefaultWebhook(capi).register()
     TpuInjectWebhook(capi).register()

@@ -821,6 +821,11 @@ def main() -> int:
     ap.add_argument("--slices", default="v5p-16=2",
                     help="comma list of acceleratorType=count node pools")
     ap.add_argument("--notebooks", type=int, default=3)
+    ap.add_argument("--jobs", type=int, default=0,
+                    help="in-process mode: also storm N multi-role "
+                         "TPUJobs (learner slice + 4 CPU actors each) "
+                         "over a dedicated node pool and assert every "
+                         "gang assembles whole")
     ap.add_argument("--wallclock", action="store_true",
                     help="real sockets + watch threads; wall-time p50")
     ap.add_argument("--concurrency", type=int, default=1,
@@ -1028,17 +1033,55 @@ def main() -> int:
                 resume_lat[max(0, int(len(resume_lat) * 0.95) - 1)]
                 * 1e3, 1))
 
+    # multi-role gang jobs arm: storm N TPUJobs over a dedicated node
+    # pool (the notebook fleet is sized for notebooks); every gang —
+    # learner slice + CPU actors — must assemble whole, all-or-nothing
+    jobs_section = None
+    if args.jobs:
+        from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
+        for s in range(args.jobs):
+            for h in range(topo.hosts):
+                api.create(make_tpu_node(f"{accel}-job{s}-h{h}", accel))
+        t0 = time.perf_counter()
+        for j in range(args.jobs):
+            api.create(tj_api.make_tpujob(
+                f"conf-job-{j}", "conformance", roles=[
+                    {"name": "learner", "replicas": 1,
+                     "tpu": {"acceleratorType": accel}},
+                    {"name": "actors", "replicas": 4, "cpu": "1"},
+                ]))
+        mgr.run_until_idle()
+        gang_pods = 0
+        for j in range(args.jobs):
+            job = api.get(tj_api.KIND, f"conf-job-{j}", "conformance")
+            st = job.get("status") or {}
+            assert st.get("phase") == tj_api.RUNNING_PHASE, (
+                f"conf-job-{j} gang never assembled: {st}")
+            assert st.get("readyPods") == st.get("totalPods"), st
+            gang_pods += st["totalPods"]
+        jobs_section = {
+            "count": args.jobs,
+            "actors_per_job": 4,
+            "gang_pods": gang_pods,
+            "wall_ms": round(1e3 * (time.perf_counter() - t0), 1),
+        }
+
     p50 = sorted(t for t, _ in latencies)[len(latencies) // 2]
-    print(json.dumps({
+    result = {
         "notebooks": args.notebooks,
         "slice": accel,
         "hosts_per_slice": topo.hosts,
         "oversubscribe": not args.no_oversubscribe,
         "provision_p50_ms": round(p50 * 1e3, 1),
         "suspend_resume": suspend_resume,
+        **({"jobs": jobs_section} if jobs_section else {}),
         "total_s": round(total, 2),
         "reconciles_per_spawn": [r for _, r in latencies],
-    }))
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
     print("CONFORMANCE OK")
     return 0
 

@@ -37,6 +37,7 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     """
     from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
     from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api
+    from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
     from kubeflow_rm_tpu.controlplane.controllers.culling import (
         CullingController,
     )
@@ -72,6 +73,7 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
                     **({"clock": clock} if clock else {}))
     api.register_validator(nb_api.KIND, nb_api.validate)
     api.register_validator(pd_api.KIND, pd_api.validate)
+    api.register_validator(tj_api.KIND, tj_api.validate)
 
     # admission order: notebook webhook on Notebooks; for pods, the
     # PodDefault merge runs before TPU injection (injection must see the
@@ -93,8 +95,12 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     # registers its watcher BEFORE the Manager's, so the store is
     # already updated when a reconcile fires for an event.
     from kubeflow_rm_tpu.controlplane.cache import CachedAPI
+    from kubeflow_rm_tpu.controlplane.controllers.tpujob import (
+        TPUJobController,
+    )
     manager = Manager(CachedAPI(api) if cache else api)
     manager.add(NotebookController())
+    manager.add(TPUJobController())
     manager.add(LockReleaseController())
     manager.add(AuthCompanionController())
     manager.add(SliceHealthController())
@@ -152,6 +158,9 @@ def make_cluster_manager(api, *, enable_culling: bool = True,
     )
 
     from kubeflow_rm_tpu.controlplane.cache import CachedAPI
+    from kubeflow_rm_tpu.controlplane.controllers.tpujob import (
+        TPUJobController,
+    )
     if not isinstance(api, CachedAPI):
         # against the kube adapter this adopts the adapter's informer-
         # fed ObjectStore (one cache, two consumers); reads stay
@@ -159,6 +168,7 @@ def make_cluster_manager(api, *, enable_culling: bool = True,
         api = CachedAPI(api)
     manager = Manager(api)
     manager.add(NotebookController())
+    manager.add(TPUJobController())
     manager.add(LockReleaseController())
     manager.add(AuthCompanionController())
     manager.add(SliceHealthController())
@@ -175,7 +185,7 @@ def make_cluster_manager(api, *, enable_culling: bool = True,
 
 # kinds the cluster manager watches (one watch thread per kind)
 WATCHED_KINDS = (
-    "Notebook", "Profile", "Tensorboard", "PVCViewer",
+    "Notebook", "TPUJob", "Profile", "Tensorboard", "PVCViewer",
     "StatefulSet", "Deployment", "Service", "Pod", "Event",
     # owned satellite kinds: controller-runtime's Owns() starts an
     # informer per owned type, which is what lets the cached client

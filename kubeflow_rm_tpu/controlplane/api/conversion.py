@@ -138,9 +138,61 @@ def _hub_to_annotations(obj: dict, acc_key: str,
     return obj
 
 
+# ---- TPUJob (multi-role gang jobs) -----------------------------------
+
+#: both pre-hub TPUJob spokes carry the role list as ONE JSON
+#: annotation under the same key — the kind predates neither prefix
+#: convention (it is new), so there is no key rename to model; the
+#: spokes exist to exercise the conversion seam the moment the roles
+#: schema evolves, and the JSON carrier is lossless for ANY role set
+TPU_JOB_ROLES_ANNOTATION = "kubeflow.org/tpu-job-roles"
+
+
+def convert_tpujob(obj: dict, to_version: str) -> dict:
+    """Convert a TPUJob between served versions (hub = v1).
+
+    v1 carries ``spec.roles`` first-class; v1alpha1/v1beta1 demote it
+    to a JSON annotation (``TPU_JOB_ROLES_ANNOTATION``). Image and
+    priorityClassName are version-invariant."""
+    import json
+
+    if to_version not in SERVED_VERSIONS:
+        raise ValueError(f"unknown TPUJob version {to_version!r} "
+                         f"(served: {', '.join(SERVED_VERSIONS)})")
+    cur = version_of(obj)
+    if cur not in SERVED_VERSIONS:
+        raise ValueError(f"cannot convert from unknown version {cur!r}")
+    out = fast_deepcopy(obj)
+    if cur != STORAGE_VERSION:
+        ann = (out.get("metadata") or {}).get("annotations") or {}
+        raw = ann.pop(TPU_JOB_ROLES_ANNOTATION, None)
+        spec = out.setdefault("spec", {})
+        if raw is not None and "roles" not in spec:
+            try:
+                spec["roles"] = json.loads(raw)
+            except ValueError as e:
+                raise ValueError(
+                    f"{TPU_JOB_ROLES_ANNOTATION} is not valid JSON"
+                ) from e
+        if not ann and "annotations" in (out.get("metadata") or {}):
+            out["metadata"].pop("annotations", None)
+        elif ann:
+            out["metadata"]["annotations"] = ann
+    if to_version != STORAGE_VERSION:
+        spec = out.get("spec") or {}
+        job_roles = spec.pop("roles", None)
+        if job_roles is not None:
+            ann = out.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            ann[TPU_JOB_ROLES_ANNOTATION] = json.dumps(
+                job_roles, separators=(",", ":"))
+    out["apiVersion"] = f"{GROUP}/{to_version}"
+    return out
+
+
 #: kind -> converter; the webhook server and REST facade both dispatch
 #: through this table, so adding a multi-version kind is one entry
-CONVERTERS = {"Notebook": convert_notebook}
+CONVERTERS = {"Notebook": convert_notebook, "TPUJob": convert_tpujob}
 
 
 def convert_review(review: dict) -> dict:

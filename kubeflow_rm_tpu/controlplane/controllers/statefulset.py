@@ -30,6 +30,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     set_controller_reference,
 )
 from kubeflow_rm_tpu.controlplane.api.tpu import GOOGLE_TPU_RESOURCE
+from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
 from kubeflow_rm_tpu.controlplane.apiserver import (
     AdmissionDenied, APIServer, NotFound, is_status,
 )
@@ -95,8 +96,12 @@ class StatefulSetController(Controller):
     def watches(self):
         # ResourceQuota: a raised quota must requeue every STS in its
         # namespace immediately — a quota-rejected slice used to wait
-        # out a 30s poll before admission
+        # out a 30s poll before admission.
+        # Gang pods additionally fan out to EVERY role STS of their
+        # TPUJob — the gang's binder must wake when a sibling role's
+        # pods appear, and pod-create events only map to their owner
         return (("Pod", map_to_owner("StatefulSet")),
+                ("Pod", _map_gang_pod),
                 ("ResourceQuota", map_all_in_namespace("StatefulSet")))
 
     def reconcile(self, api: APIServer, req: Request):
@@ -270,6 +275,12 @@ class StatefulSetController(Controller):
     _bind_lock = __import__("threading").Lock()
 
     def _schedule_and_run(self, api: APIServer, sts: dict) -> None:
+        if _gang_of(sts) is not None:
+            # multi-role gangs always take the cached assume/bind path:
+            # mixed-resource all-or-nothing placement needs the dual
+            # (chips, cpu) accounting the legacy scan never had
+            self._schedule_and_run_cached(api, sts)
+            return
         if scheduler.legacy_scan():
             with self._bind_lock:
                 self._schedule_and_run_locked(api, sts)
@@ -289,7 +300,8 @@ class StatefulSetController(Controller):
                 # in-memory yes, real cluster no
                 else isinstance(getattr(api, "api", api), APIServer))
 
-    def _mark_unschedulable(self, api: APIServer, pod: dict) -> None:
+    def _mark_unschedulable(self, api: APIServer, pod: dict,
+                            message: str | None = None) -> None:
         if deep_get(pod, "status", "phase") != "Pending":
             pod["status"] = {"phase": "Pending"}
             api.update_status(pod)
@@ -297,8 +309,8 @@ class StatefulSetController(Controller):
                    for e in api.events_for(pod)):
             api.record_event(
                 pod, "Warning", "FailedScheduling",
-                "no node matches TPU nodeSelector with free "
-                f"{GOOGLE_TPU_RESOURCE} capacity")
+                message or ("no node matches TPU nodeSelector with free "
+                            f"{GOOGLE_TPU_RESOURCE} capacity"))
 
     def _schedule_and_run_cached(self, api: APIServer, sts: dict) -> None:
         """Assume/bind over the incremental usage cache: the whole
@@ -322,6 +334,10 @@ class StatefulSetController(Controller):
                     self.mark_running(api, pod)
                 continue
             unbound.append(pod)
+        gang = _gang_of(sts)
+        if gang is not None:
+            self._schedule_gang(api, sts, gang, sched)
+            return
         if not unbound:
             return
         allow_virtual = self._allow_virtual(api)
@@ -344,6 +360,56 @@ class StatefulSetController(Controller):
             except Exception:
                 # bind write lost (conflict/deleted): release the
                 # assumed charge; the retried reconcile re-plans
+                sched.forget(key)
+                raise
+            sched.confirm(key, deep_get(
+                live, "metadata", "resourceVersion", default=0))
+            if self.auto_ready:
+                self.mark_running(api, pod, live=live)
+
+    def _schedule_gang(self, api: APIServer, sts: dict,
+                       gang: tuple[str, list[dict]], sched) -> None:
+        """Bind a TPUJob's WHOLE heterogeneous gang — every role's pods
+        across every role StatefulSet — in one mixed-resource assume
+        transaction. Exactly one STS acts as the binder (the first
+        role's — deterministic, so two role reconciles never race a
+        bind for the same pod); the others only run the kubelet half.
+        Binding waits until every role has materialised its pods: a
+        half-created gang is never partially placed."""
+        job, roles = gang
+        ns = namespace_of(sts)
+        if name_of(sts) != tj_api.role_sts_name(
+                job, roles[0].get("name", "")):
+            return  # not the binder; _map_gang_pod keeps it requeued
+        expected = sum(int(r.get("pods") or 0) for r in roles)
+        gang_pods = [
+            p for p in api.list(
+                "Pod", ns,
+                {"matchLabels": {tj_api.JOB_NAME_LABEL: job}})
+            if deep_get(p, "status", "phase") not in TERMINAL_PHASES
+        ]
+        if len(gang_pods) < expected:
+            return  # sibling roles still creating; their events requeue
+        unbound = sorted(
+            [p for p in gang_pods
+             if not deep_get(p, "spec", "nodeName")], key=name_of)
+        if not unbound:
+            return
+        plan = sched.gang_bind(unbound,
+                               allow_virtual=self._allow_virtual(api))
+        if plan is None:
+            msg = (f"gang of {expected} pods ({len(roles)} roles) does "
+                   "not fit: needs chip AND cpu headroom on matching "
+                   "nodes; nothing was placed (all-or-nothing)")
+            for pod in unbound:
+                self._mark_unschedulable(api, pod, message=msg)
+            return
+        for pod in unbound:
+            key = (namespace_of(pod), name_of(pod))
+            pod["spec"]["nodeName"] = plan[key]
+            try:
+                live = api.update(pod)
+            except Exception:
                 sched.forget(key)
                 raise
             sched.confirm(key, deep_get(
@@ -493,6 +559,34 @@ class DeploymentController(StatefulSetController):
         if deep_get(deploy, "status") != status:
             deploy["status"] = status
             api.update_status(deploy)
+
+
+def _gang_of(sts: dict) -> tuple[str, list[dict]] | None:
+    """(job_name, roles) when this STS is one role of a TPUJob gang —
+    read off the pod template's gang label + roles annotation, so the
+    binder needs no TPUJob CR round-trip."""
+    tmpl_md = deep_get(sts, "spec", "template", "metadata",
+                       default={}) or {}
+    job = (tmpl_md.get("labels") or {}).get(tj_api.JOB_NAME_LABEL)
+    if not job:
+        return None
+    roles = tj_api.parse_roles_annotation({"metadata": tmpl_md})
+    if not roles:
+        return None
+    return job, roles
+
+
+def _map_gang_pod(obj: dict) -> list[Request]:
+    """Fan a gang pod's events out to every role STS of its TPUJob —
+    the binder (first role's STS) must reconcile when ANY role's pods
+    change, and plain ownership mapping only reaches one role."""
+    job = labels_of(obj).get(tj_api.JOB_NAME_LABEL)
+    if not job:
+        return []
+    roles = tj_api.parse_roles_annotation(obj) or []
+    ns = namespace_of(obj)
+    return [Request(ns, tj_api.role_sts_name(job, r["name"]))
+            for r in roles if r.get("name")]
 
 
 def _ordinal(pod_name: str, sts_name: str) -> int | None:

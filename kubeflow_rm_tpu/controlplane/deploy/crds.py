@@ -171,6 +171,116 @@ def notebook_crd() -> dict:
     return crd
 
 
+def tpujob_crd() -> dict:
+    """kubeflow.org/v1 TPUJob — multi-role gang jobs (the validator in
+    ``api/tpujob.py:validate`` rendered as schema). Spokes v1alpha1 and
+    v1beta1 carry the role list as a JSON annotation
+    (``api/conversion.py:TPU_JOB_ROLES_ANNOTATION``), converted through
+    the same webhook as Notebook."""
+    from kubeflow_rm_tpu.controlplane.api.tpujob import (
+        MAX_ROLE_REPLICAS,
+        MAX_ROLES,
+    )
+    role_schema = {
+        "type": "object",
+        "required": ["name"],
+        "properties": {
+            "name": {
+                "type": "string",
+                "pattern": r"^[a-z]([a-z0-9-]{0,30}[a-z0-9])?$",
+            },
+            "replicas": {
+                "type": "integer",
+                "minimum": 1,
+                "maximum": MAX_ROLE_REPLICAS,
+                "description": "Slices for TPU roles (pods = replicas "
+                               "× hosts), pods for CPU roles.",
+            },
+            "tpu": {
+                "type": "object",
+                "required": ["acceleratorType"],
+                "properties": {
+                    "acceleratorType": {
+                        "type": "string",
+                        "enum": sorted(tpu_api.TOPOLOGIES),
+                    },
+                },
+            },
+            "cpu": {
+                "type": "string",
+                "description": "Per-pod CPU request for chipless "
+                               "roles (quantity, e.g. \"2\" or "
+                               "\"500m\").",
+            },
+            "template": _ANY,
+        },
+    }
+    schema = {
+        "type": "object",
+        "properties": {
+            "spec": {
+                "type": "object",
+                "required": ["roles"],
+                "properties": {
+                    "roles": {
+                        "type": "array",
+                        "minItems": 1,
+                        "maxItems": MAX_ROLES,
+                        "items": role_schema,
+                    },
+                    "image": {"type": "string"},
+                    "priorityClassName": {"type": "string"},
+                },
+            },
+            "status": {
+                "type": "object",
+                "properties": {
+                    "phase": {"type": "string"},
+                    "readyPods": {"type": "integer"},
+                    "totalPods": {"type": "integer"},
+                    "roles": _ANY,
+                },
+            },
+        },
+    }
+    cols = [
+        {"name": "Phase", "type": "string", "jsonPath": ".status.phase"},
+        {"name": "Ready", "type": "integer",
+         "jsonPath": ".status.readyPods"},
+        {"name": "Total", "type": "integer",
+         "jsonPath": ".status.totalPods"},
+        {"name": "Age", "type": "date",
+         "jsonPath": ".metadata.creationTimestamp"},
+    ]
+    # spokes: spec.roles demoted to the JSON roles annotation
+    import copy as _copy
+    spoke_schema = _copy.deepcopy(schema)
+    del spoke_schema["properties"]["spec"]["properties"]["roles"]
+    spoke_schema["properties"]["spec"].pop("required", None)
+    crd = _crd("kubeflow.org", "TPUJob", "tpujobs",
+               [_version("v1alpha1", _copy.deepcopy(spoke_schema),
+                         storage=False),
+                _version("v1beta1", _copy.deepcopy(spoke_schema),
+                         storage=False),
+                _version("v1", schema, printer_columns=cols)],
+               short_names=["tj"], categories=["kubeflow"])
+    crd["spec"]["conversion"] = {
+        "strategy": "Webhook",
+        "webhook": {
+            "conversionReviewVersions": ["v1"],
+            "clientConfig": {
+                "service": {
+                    "name": "webhook",
+                    "namespace": "kubeflow",
+                    "path": "/convert",
+                    "port": 443,
+                },
+            },
+        },
+    }
+    return crd
+
+
 def profile_crd() -> dict:
     schema = {
         "type": "object",
@@ -284,8 +394,8 @@ def pvcviewer_crd() -> dict:
 
 
 def all_crds() -> list[dict]:
-    return [notebook_crd(), profile_crd(), poddefault_crd(),
-            tensorboard_crd(), pvcviewer_crd()]
+    return [notebook_crd(), tpujob_crd(), profile_crd(),
+            poddefault_crd(), tensorboard_crd(), pvcviewer_crd()]
 
 
 def render_yaml(objs: list[dict]) -> str:

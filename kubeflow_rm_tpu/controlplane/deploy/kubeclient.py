@@ -95,6 +95,7 @@ RESOURCES: dict[str, tuple[str, str, bool]] = {
     "Route": ("apis/route.openshift.io/v1", "routes", True),
     # this platform's CRDs (deploy/crds.py)
     "Notebook": ("apis/kubeflow.org/v1", "notebooks", True),
+    "TPUJob": ("apis/kubeflow.org/v1", "tpujobs", True),
     "Profile": ("apis/kubeflow.org/v1", "profiles", False),
     "PodDefault": ("apis/kubeflow.org/v1alpha1", "poddefaults", True),
     "Tensorboard": ("apis/tensorboard.kubeflow.org/v1alpha1",

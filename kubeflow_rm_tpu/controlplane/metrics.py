@@ -285,6 +285,28 @@ SUSPEND_RESUME_SECONDS = Histogram(
 )
 
 
+# ---- multi-role gang jobs (TPUJob): actor-learner workloads ----------
+TPUJOB_RUNNING = Gauge(
+    "tpujob_running",
+    "TPUJobs whose whole heterogeneous gang (every role) is Running",
+    registry=REGISTRY,
+)
+TPUJOB_READY_PODS = Gauge(
+    "tpujob_ready_pods",
+    "Ready gang pods across all TPUJobs, by role name (learner slice "
+    "hosts vs CPU actors)",
+    ["role"],
+    registry=REGISTRY,
+)
+TPUJOB_PHASE_TRANSITIONS_TOTAL = Counter(
+    "tpujob_phase_transitions_total",
+    "TPUJob phase-ladder transitions (Pending -> Provisioning -> "
+    "Running -> Succeeded/Failed, plus Suspended), by entered phase",
+    ["phase"],
+    registry=REGISTRY,
+)
+
+
 # ---- sharded control plane: durable WAL + snapshot + ring ------------
 # Every gauge below carries a ``shard`` label: each shard runs in its
 # own process with its own registry, so the label is what lets a

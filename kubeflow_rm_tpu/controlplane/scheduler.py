@@ -26,6 +26,13 @@ that with what kube-scheduler actually does (``scheduler/cache/cache.go``):
 Terminal pods (``Succeeded``/``Failed``) hold no capacity — a failed
 host frees its chips the moment its status event lands, where the old
 full scan leaked them forever (the r10 satellite bugfix).
+
+The cache is **mixed-resource** (r11, multi-role gang jobs): every node
+tracks chips AND CPU, and ``gang_bind`` places heterogeneous gangs — a
+learner slice's chip pods co-bound with CPU-only actor pods in one
+assume transaction. CPU-only pods never touch chip accounting, chip
+pods without CPU requests never touch CPU accounting, and a partial
+fit still rolls back to zero assumed binds across BOTH resources.
 """
 
 from __future__ import annotations
@@ -58,39 +65,60 @@ VIRTUAL_NODE = "virtual-node"
 _ASSUMED = float("inf")
 
 
-def _pod_chips(pod: dict) -> float:
-    """TPU chips a pod occupies: requests defaulting to limits (the
-    kube quota convention — mirrors ``statefulset._pod_tpu_request``)."""
+#: the second tracked resource (mixed-resource gangs): CPU cores,
+#: parsed with millicore support ("500m" → 0.5)
+CPU_RESOURCE = "cpu"
+
+
+def _pod_resource(pod: dict, resource: str) -> float:
+    """Amount of ``resource`` a pod occupies: requests defaulting to
+    limits (the kube quota convention — mirrors
+    ``statefulset._pod_tpu_request``)."""
     total = 0.0
     for c in deep_get(pod, "spec", "containers", default=[]) or []:
-        amount = deep_get(c, "resources", "requests", GOOGLE_TPU_RESOURCE)
+        amount = deep_get(c, "resources", "requests", resource)
         if amount is None:
-            amount = deep_get(c, "resources", "limits", GOOGLE_TPU_RESOURCE)
+            amount = deep_get(c, "resources", "limits", resource)
         if amount is not None:
             total += parse_quantity(amount)
     return total
 
 
+def _pod_chips(pod: dict) -> float:
+    return _pod_resource(pod, GOOGLE_TPU_RESOURCE)
+
+
+def _pod_cpu(pod: dict) -> float:
+    return _pod_resource(pod, CPU_RESOURCE)
+
+
 class _Node:
-    """One node's slice of the usage map. ``used`` is guarded by the
-    node's own lock — binds against different nodes never contend."""
+    """One node's slice of the usage map — both resources under one
+    lock so a mixed bind is atomic per node. ``used``/``cpu_used`` are
+    guarded by the node's own lock — binds against different nodes
+    never contend."""
 
-    __slots__ = ("name", "labels", "capacity", "used", "lock")
+    __slots__ = ("name", "labels", "capacity", "used",
+                 "cpu_capacity", "cpu_used", "lock")
 
-    def __init__(self, name: str, labels: dict, capacity: float):
+    def __init__(self, name: str, labels: dict, capacity: float,
+                 cpu_capacity: float = 0.0):
         self.name = name
         self.labels = labels
-        self.capacity = capacity
-        self.used = 0.0
+        self.capacity = capacity        # chips
+        self.used = 0.0                 # chips
+        self.cpu_capacity = cpu_capacity
+        self.cpu_used = 0.0
         self.lock = threading.Lock()
 
 
 class _Entry:
-    __slots__ = ("node", "chips", "rv")
+    __slots__ = ("node", "chips", "cpu", "rv")
 
-    def __init__(self, node: str, chips: float, rv: float):
+    def __init__(self, node: str, chips: float, cpu: float, rv: float):
         self.node = node
         self.chips = chips
+        self.cpu = cpu
         self.rv = rv
 
 
@@ -139,12 +167,16 @@ class SchedulerCache:
             cap = parse_quantity(deep_get(
                 obj, "status", "allocatable", GOOGLE_TPU_RESOURCE,
                 default=0))
+            cpu_cap = parse_quantity(deep_get(
+                obj, "status", "allocatable", CPU_RESOURCE, default=0))
             if node is None:
-                self._nodes[name] = _Node(name, labels_of(obj), cap)
+                self._nodes[name] = _Node(name, labels_of(obj), cap,
+                                          cpu_cap)
             else:
                 # keep the object (its lock + used survive relabels)
                 node.labels = labels_of(obj)
                 node.capacity = cap
+                node.cpu_capacity = cpu_cap
 
     def _apply_pod(self, etype: str, obj: dict) -> None:
         from kubeflow_rm_tpu.controlplane import metrics
@@ -158,6 +190,7 @@ class SchedulerCache:
                 or deep_get(obj, "status", "phase") in TERMINAL_PHASES)
         node_name = None if gone else deep_get(obj, "spec", "nodeName")
         chips = _pod_chips(obj)
+        cpu = _pod_cpu(obj)
         with self._plock:
             cur = self._pods.get(key)
             if cur is not None and rv < cur.rv:
@@ -165,24 +198,25 @@ class SchedulerCache:
                 # already charged this pod at a later version — applying
                 # the older view would free chips that are still held
                 return
-            dec = (cur.node, cur.chips) if cur is not None else None
+            dec = (cur.node, cur.chips, cur.cpu) if cur is not None \
+                else None
             if node_name:
-                self._pods[key] = _Entry(node_name, chips, rv)
-                inc = (node_name, chips)
+                self._pods[key] = _Entry(node_name, chips, cpu, rv)
+                inc = (node_name, chips, cpu)
             else:
                 self._pods.pop(key, None)
                 inc = None
         self._adjust(dec, inc)
 
-    def _adjust(self, dec: tuple[str, float] | None,
-                inc: tuple[str, float] | None) -> None:
+    def _adjust(self, dec: tuple[str, float, float] | None,
+                inc: tuple[str, float, float] | None) -> None:
         if dec == inc:
             return
-        for node_name, delta in ((dec, -1), (inc, +1)):
-            if node_name is None:
+        for charge, delta in ((dec, -1), (inc, +1)):
+            if charge is None:
                 continue
-            name, chips = node_name
-            if not chips:
+            name, chips, cpu = charge
+            if not chips and not cpu:
                 continue
             with self._nlock:
                 node = self._nodes.get(name)
@@ -190,6 +224,7 @@ class SchedulerCache:
                 continue  # virtual node / node gone: untracked capacity
             with node.lock:
                 node.used = max(0.0, node.used + delta * chips)
+                node.cpu_used = max(0.0, node.cpu_used + delta * cpu)
 
     # -- snapshot rebuild (prime + TOO_OLD recovery) -------------------
     def rebuild(self, api) -> None:
@@ -209,12 +244,17 @@ class SchedulerCache:
                     cap = parse_quantity(deep_get(
                         n, "status", "allocatable", GOOGLE_TPU_RESOURCE,
                         default=0))
+                    cpu_cap = parse_quantity(deep_get(
+                        n, "status", "allocatable", CPU_RESOURCE,
+                        default=0))
                     node = self._nodes.get(name)
                     if node is None:
-                        self._nodes[name] = _Node(name, labels_of(n), cap)
+                        self._nodes[name] = _Node(name, labels_of(n),
+                                                  cap, cpu_cap)
                     else:
                         node.labels = labels_of(n)
                         node.capacity = cap
+                        node.cpu_capacity = cpu_cap
                 for name in list(self._nodes):
                     if name not in seen:
                         del self._nodes[name]
@@ -233,17 +273,20 @@ class SchedulerCache:
                             "resourceVersion") or 0)
                     except (TypeError, ValueError):
                         rv = 0.0
-                    fresh[key] = _Entry(node_name, _pod_chips(p), rv)
+                    fresh[key] = _Entry(node_name, _pod_chips(p),
+                                        _pod_cpu(p), rv)
                 for key, e in self._pods.items():
                     if e.rv is _ASSUMED and key not in fresh:
                         fresh[key] = e
                 self._pods = fresh
-                per_node: dict[str, float] = {}
+                per_node: dict[str, tuple[float, float]] = {}
                 for e in fresh.values():
-                    per_node[e.node] = per_node.get(e.node, 0.0) + e.chips
+                    chips, cpu = per_node.get(e.node, (0.0, 0.0))
+                    per_node[e.node] = (chips + e.chips, cpu + e.cpu)
             for node in live_nodes.values():
                 with node.lock:
-                    node.used = per_node.get(node.name, 0.0)
+                    node.used, node.cpu_used = per_node.get(
+                        node.name, (0.0, 0.0))
         metrics.SCHEDULER_CACHE_REBUILDS_TOTAL.inc()
 
     def _ensure_fresh(self) -> None:
@@ -296,42 +339,56 @@ class SchedulerCache:
                     free0[node.name] = node.capacity - node.used
             nodes.sort(key=lambda n: (free0[n.name], n.name))
             plan: dict[tuple, str] = {}
-            tentative: dict[str, float] = {}
+            # per-node tentative (chips, cpu) charged by THIS gang —
+            # heterogeneous pods share the map so a learner host and an
+            # actor landing on the same node both count
+            tentative: dict[str, tuple[float, float]] = {}
             for pod in sorted(pods, key=name_of):
                 key = (namespace_of(pod), name_of(pod))
                 selector = deep_get(pod, "spec", "nodeSelector",
                                     default={}) or {}
                 need = _pod_chips(pod)
+                need_cpu = _pod_cpu(pod)
                 chosen = None
                 for node in nodes:
                     if selector and not matches_selector(
                             node.labels, {"matchLabels": selector}):
                         continue
-                    if need:
+                    if need or need_cpu:
                         with node.lock:
-                            used = node.used
-                        if (used + tentative.get(node.name, 0.0) + need
-                                > node.capacity):
+                            used, cpu_used = node.used, node.cpu_used
+                        t_chips, t_cpu = tentative.get(
+                            node.name, (0.0, 0.0))
+                        if need and (used + t_chips + need
+                                     > node.capacity):
+                            continue
+                        if need_cpu and (cpu_used + t_cpu + need_cpu
+                                         > node.cpu_capacity):
                             continue
                     chosen = node.name
                     break
                 if chosen is None:
-                    if allow_virtual and not selector and not need:
+                    if allow_virtual and not selector and not need \
+                            and not need_cpu:
                         plan[key] = VIRTUAL_NODE
                         continue
                     return None  # gang is all-or-nothing
                 plan[key] = chosen
-                if need:
-                    tentative[chosen] = tentative.get(chosen, 0.0) + need
+                if need or need_cpu:
+                    t_chips, t_cpu = tentative.get(chosen, (0.0, 0.0))
+                    tentative[chosen] = (t_chips + need,
+                                         t_cpu + need_cpu)
             if self._commit(pods, plan, tentative):
                 return plan
         return None
 
     def _commit(self, pods: list[dict], plan: dict[tuple, str],
-                tentative: dict[str, float]) -> bool:
-        """Re-verify capacity and charge the gang under its nodes'
-        locks (sorted acquisition — deadlock-free against sibling
-        gangs), then record the assumed entries."""
+                tentative: dict[str, tuple[float, float]]) -> bool:
+        """Re-verify BOTH resources and charge the gang under its
+        nodes' locks (sorted acquisition — deadlock-free against
+        sibling gangs), then record the assumed entries. Verification
+        failure on either axis rejects the whole gang with nothing
+        charged."""
         with self._nlock:
             locked = [self._nodes[n] for n in sorted(tentative)
                       if n in self._nodes]
@@ -342,15 +399,20 @@ class SchedulerCache:
                 node.lock.acquire()
             try:
                 for node in locked:
-                    if node.used + tentative[node.name] > node.capacity:
+                    t_chips, t_cpu = tentative[node.name]
+                    if node.used + t_chips > node.capacity:
+                        return False
+                    if node.cpu_used + t_cpu > node.cpu_capacity:
                         return False
                 for node in locked:
-                    node.used += tentative[node.name]
+                    t_chips, t_cpu = tentative[node.name]
+                    node.used += t_chips
+                    node.cpu_used += t_cpu
             finally:
                 for node in locked:
                     node.lock.release()
             from kubeflow_rm_tpu.controlplane import metrics
-            stale: list[tuple[str, float]] = []
+            stale: list[tuple[str, float, float]] = []
             with self._plock:
                 for pod in pods:
                     key = (namespace_of(pod), name_of(pod))
@@ -361,9 +423,10 @@ class SchedulerCache:
                         # charge so the gang's doesn't double-count
                         if cur.rv is _ASSUMED:
                             self._assumed -= 1
-                        stale.append((cur.node, cur.chips))
+                        stale.append((cur.node, cur.chips, cur.cpu))
                     self._pods[key] = _Entry(
-                        plan[key], _pod_chips(pod), _ASSUMED)
+                        plan[key], _pod_chips(pod), _pod_cpu(pod),
+                        _ASSUMED)
                     self._assumed += 1
                 metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
             for dec in stale:
@@ -395,7 +458,7 @@ class SchedulerCache:
             del self._pods[key]
             self._assumed -= 1
             metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
-        self._adjust((e.node, e.chips), None)
+        self._adjust((e.node, e.chips, e.cpu), None)
 
     def release(self, key: tuple) -> None:
         """Out-of-band eviction for suspend/preemption teardown: the
@@ -414,7 +477,7 @@ class SchedulerCache:
             if e.rv is _ASSUMED:
                 self._assumed -= 1
                 metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
-        self._adjust((e.node, e.chips), None)
+        self._adjust((e.node, e.chips, e.cpu), None)
 
     # -- read-side helpers ---------------------------------------------
     def total_used(self) -> float:
@@ -435,6 +498,14 @@ class SchedulerCache:
             return 0.0
         with node.lock:
             return node.used
+
+    def node_cpu_used(self, name: str) -> float:
+        with self._nlock:
+            node = self._nodes.get(name)
+        if node is None:
+            return 0.0
+        with node.lock:
+            return node.cpu_used
 
     def free_by_node(self) -> dict[str, tuple[float, dict]]:
         """Snapshot of ``{node: (free_chips, labels)}`` — the read side
@@ -463,9 +534,11 @@ class SchedulerCache:
         with self._nlock:
             nodes = list(self._nodes.values())
         free: list[float] = []
+        free_cpu = 0.0
         for node in nodes:
             with node.lock:
                 free.append(max(0.0, node.capacity - node.used))
+                free_cpu += max(0.0, node.cpu_capacity - node.cpu_used)
         free_chips = sum(free)
         largest = 0.0
         for i, f in enumerate(sorted(free, reverse=True)):
@@ -478,6 +551,7 @@ class SchedulerCache:
         metrics.SCHEDULER_FRAGMENTATION.set(frag)
         return {"nodes": len(nodes), "pods": pods, "assumed": assumed,
                 "stale": self._stale, "free_chips": free_chips,
+                "free_cpu": free_cpu,
                 "largest_free_gang": largest, "fragmentation": frag}
 
 

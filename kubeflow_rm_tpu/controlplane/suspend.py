@@ -164,8 +164,13 @@ def _update_retrying(api: APIServer, notebook: dict,
     the webapp on the same annotations map. Always starts from a fresh
     ``get()`` copy: callers may hold ``scan()`` store references, and
     mutating those in place would make the write a self-comparing
-    no-op under the cache's suppression."""
-    notebook = api.get(nb_api.KIND, name_of(notebook),
+    no-op under the cache's suppression.
+
+    Kind-agnostic: the suspend annotation vocabulary is shared by
+    Notebook and TPUJob, so the verbs below drive both — the kind is
+    taken from the object itself."""
+    kind = notebook.get("kind") or nb_api.KIND
+    notebook = api.get(kind, name_of(notebook),
                        namespace_of(notebook))
     for _ in range(8):
         if not mutate(notebook):
@@ -173,9 +178,9 @@ def _update_retrying(api: APIServer, notebook: dict,
         try:
             return api.update(notebook)
         except Conflict:
-            notebook = api.get(nb_api.KIND, name_of(notebook),
+            notebook = api.get(kind, name_of(notebook),
                                namespace_of(notebook))
-    raise Conflict(f"could not update notebook {name_of(notebook)} "
+    raise Conflict(f"could not update {kind} {name_of(notebook)} "
                    "after 8 attempts")
 
 

@@ -181,6 +181,16 @@ def _controlplane_section(api=None) -> dict:
                 for p in ("drain", "rebind", "restore")
             },
         },
+        # multi-role gang jobs (TPUJob): live gangs, per-role
+        # readiness (summed across roles here; split by label in the
+        # /metrics exposition), phase-transition churn
+        "jobs": {
+            "running": cp_metrics.registry_value("tpujob_running"),
+            "ready_pods": cp_metrics.registry_value(
+                "tpujob_ready_pods"),
+            "phase_transitions": cp_metrics.registry_value(
+                "tpujob_phase_transitions_total"),
+        },
         # durable sharded control plane: WAL group-commit and snapshot
         # health plus ring membership. shard is THIS process's identity
         # ("" = unsharded); counters sum across shard labels when a
@@ -409,6 +419,13 @@ class PrometheusMetricsService:
                         "seconds": g.get(
                             "suspend_resume_phase_seconds_sum"),
                     },
+                },
+                # role/phase labels summed by the flat scrape
+                "jobs": {
+                    "running": g.get("tpujob_running"),
+                    "ready_pods": g.get("tpujob_ready_pods"),
+                    "phase_transitions": g.get(
+                        "tpujob_phase_transitions_total"),
                 },
                 # shard labels summed by the flat scrape: fleet-wide
                 # WAL/snapshot totals (per-shard split needs the

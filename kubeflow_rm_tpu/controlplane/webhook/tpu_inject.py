@@ -25,6 +25,7 @@ import copy
 
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
 from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
 from kubeflow_rm_tpu.controlplane.api.meta import (
     fast_deepcopy,
     annotations_of,
@@ -54,8 +55,22 @@ class TpuInjectWebhook:
                 nb_api.TPU_INJECT_EXCLUDE_ANNOTATION) == "true":
             return None
         acc_type = labels_of(pod).get(nb_api.TPU_ACCELERATOR_LABEL)
+        in_gang = tj_api.JOB_NAME_LABEL in labels_of(pod)
         if not acc_type:
+            if in_gang:
+                # CPU-only gang member (an actor): role rendezvous env
+                # only — the TPU-scoped vars (TPU_WORKER_ID/
+                # TPU_WORKER_HOSTNAMES) stay slice-scoped and are NOT
+                # injected into chipless pods
+                pod = fast_deepcopy(pod)
+                self._inject_role_env(pod)
+                return pod
             return None
+        if in_gang:
+            # a gang's chip pods (the learner slice) get BOTH the
+            # role env and the slice-scoped TPU rendezvous below
+            pod = fast_deepcopy(pod)
+            self._inject_role_env(pod)
         topo = tpu_api.lookup(acc_type)
         nslices = int(labels_of(pod).get(
             nb_api.TPU_NUM_SLICES_LABEL, "1"))
@@ -87,6 +102,52 @@ class TpuInjectWebhook:
         if not any(v.get("name") == SHM_VOLUME["name"] for v in vols):
             vols.append(copy.deepcopy(SHM_VOLUME))
         return pod
+
+    def _inject_role_env(self, pod: dict) -> None:
+        """Role-aware gang rendezvous (mutates ``pod`` in place):
+        every member of a TPUJob gang — chip pods and CPU actors alike
+        — learns its role, its ordinal within the role, its own role's
+        peer hostnames, every sibling role's hostname list, and the
+        learner's address (pod 0 of the anchor role), so the gang
+        self-assembles without polling the control plane."""
+        labels = labels_of(pod)
+        job = labels.get(tj_api.JOB_NAME_LABEL) or ""
+        role = labels.get(tj_api.JOB_ROLE_LABEL) or ""
+        roles = tj_api.parse_roles_annotation(pod) or []
+        ns = namespace_of(pod)
+        ordinal = _pod_ordinal(pod)
+
+        role_hosts: dict[str, list[str]] = {}
+        for r in roles:
+            rname = r.get("name")
+            if not rname:
+                continue
+            svc = r.get("service") or tj_api.role_sts_name(job, rname)
+            role_hosts[rname] = [
+                f"{svc}-{i}.{svc}.{ns}.svc.{self.cluster_domain}"
+                for i in range(int(r.get("pods") or 0))
+            ]
+        own_hosts = role_hosts.get(role, [])
+        learner = tj_api.learner_role(roles)
+        learner_addr = ""
+        if learner is not None:
+            anchor = role_hosts.get(learner.get("name") or "", [])
+            if anchor:
+                learner_addr = anchor[0]
+
+        for c in pod["spec"].get("containers") or []:
+            env = c.setdefault("env", [])
+            _upsert(env, tj_api.ENV_JOB_NAME, job)
+            _upsert(env, tj_api.ENV_JOB_ROLE, role)
+            _upsert(env, tj_api.ENV_JOB_ROLE_INDEX, str(ordinal))
+            _upsert(env, tj_api.ENV_JOB_ROLE_HOSTNAMES,
+                    ",".join(own_hosts))
+            for rname, hosts in role_hosts.items():
+                suffix = rname.upper().replace("-", "_")
+                _upsert(env, tj_api.ENV_JOB_HOSTNAMES_PREFIX + suffix,
+                        ",".join(hosts))
+            if learner_addr:
+                _upsert(env, tj_api.ENV_LEARNER_ADDRESS, learner_addr)
 
     def _worker_hostnames(self, pod: dict, topo: tpu_api.SliceTopology,
                           slice_id: int = 0) -> list[str]:

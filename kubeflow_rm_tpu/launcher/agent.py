@@ -22,9 +22,11 @@ small.
 
 from __future__ import annotations
 
+import dataclasses
 import http.server
 import json
 import logging
+import os
 import threading
 
 log = logging.getLogger("kubeflow_rm_tpu.launcher")
@@ -32,13 +34,75 @@ log = logging.getLogger("kubeflow_rm_tpu.launcher")
 HEALTH_PORT = 8080
 
 
+@dataclasses.dataclass(frozen=True)
+class RoleEnv:
+    """The TPUJob half of the rendezvous contract (webhook → agent).
+
+    Parsed from the ``TPU_JOB_*`` vars the tpu_inject webhook stamps on
+    every gang member — chip pods and CPU actors alike. The TPU-scoped
+    vars (``TPU_WORKER_*``) remain a separate, slice-local contract:
+    an actor pod has the role env but NOT the TPU env, which is how the
+    agent tells the two apart.
+    """
+    job: str
+    role: str
+    role_index: int
+    role_hostnames: tuple[str, ...]
+    #: every role's hostname list, keyed by the role name as it appears
+    #: in the job spec (lowercased back from the env-var suffix)
+    peers: dict[str, tuple[str, ...]]
+    learner_address: str
+
+    @property
+    def in_gang(self) -> bool:
+        return bool(self.job)
+
+
+def role_env(environ=None) -> RoleEnv:
+    """Parse the ``TPU_JOB_*`` rendezvous env; never raises — absent
+    vars yield an empty ``RoleEnv`` (``in_gang`` False)."""
+    from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
+    e = os.environ if environ is None else environ
+    try:
+        idx = int(e.get(tj_api.ENV_JOB_ROLE_INDEX, "0"))
+    except ValueError:
+        idx = 0
+    peers: dict[str, tuple[str, ...]] = {}
+    for key, val in e.items():
+        if not key.startswith(tj_api.ENV_JOB_HOSTNAMES_PREFIX):
+            continue
+        rname = key[len(tj_api.ENV_JOB_HOSTNAMES_PREFIX):]
+        peers[rname.lower().replace("_", "-")] = tuple(
+            h for h in val.split(",") if h)
+    own = tuple(h for h in e.get(
+        tj_api.ENV_JOB_ROLE_HOSTNAMES, "").split(",") if h)
+    return RoleEnv(
+        job=e.get(tj_api.ENV_JOB_NAME, ""),
+        role=e.get(tj_api.ENV_JOB_ROLE, ""),
+        role_index=idx,
+        role_hostnames=own,
+        peers=peers,
+        learner_address=e.get(tj_api.ENV_LEARNER_ADDRESS, ""),
+    )
+
+
 class WorkerAgent:
     def __init__(self, environ=None, *, health_port: int = HEALTH_PORT):
         from kubeflow_rm_tpu.parallel.distributed import tpu_env
         self.env = tpu_env(environ)
+        self.role = role_env(environ)
         self.health_port = health_port
         self._httpd = None
         self._ready = False
+
+    @property
+    def is_actor(self) -> bool:
+        """A CPU-only gang member: role rendezvous env but no TPU env.
+
+        Actors never join ``jax.distributed`` — the learner slice is
+        its own SPMD world; actors talk to it over the learner address
+        (``TPU_JOB_LEARNER_ADDRESS``) at the application layer."""
+        return self.role.in_gang and not self.env.accelerator_type
 
     @property
     def is_worker_zero(self) -> bool:
@@ -61,6 +125,10 @@ class WorkerAgent:
                     "ready": agent._ready,
                     "worker_id": agent.env.worker_id,
                     "hosts": agent.env.num_hosts,
+                    **({"job": agent.role.job,
+                        "role": agent.role.role,
+                        "role_index": agent.role.role_index}
+                       if agent.role.in_gang else {}),
                 }).encode()
                 self.send_response(200 if agent._ready else 503)
                 self.send_header("Content-Type", "application/json")
@@ -157,10 +225,24 @@ def dict_env(env) -> dict:
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     agent = WorkerAgent()
+    if agent.is_actor:
+        # CPU actor in a TPUJob gang: nothing to rendezvous at the jax
+        # layer — serve readiness and idle; the actor program (the
+        # container's own command) does the trajectory work against
+        # TPU_JOB_LEARNER_ADDRESS
+        log.info("actor %s[%d] of job %s: learner at %s",
+                 agent.role.role, agent.role.role_index,
+                 agent.role.job, agent.role.learner_address or "<none>")
+        agent.start_health_server()
+        agent._ready = True
+        agent.run_forever()
+        return
     if agent.is_worker_zero:
-        # worker 0 runs JupyterLab (separate s6 service); the agent has
+        # worker 0 runs JupyterLab (notebooks) or the learner program
+        # (TPUJob chip roles) as a separate s6 service; the agent has
         # nothing to do — exit cleanly so s6 doesn't restart-loop it
-        log.info("worker 0: JupyterLab owns this host; agent exiting")
+        log.info("worker 0: the primary program owns this host; "
+                 "agent exiting")
         return
     agent.start_health_server()
     agent.join_slice()

@@ -33,11 +33,11 @@ from kubeflow_rm_tpu.controlplane.deploy.webhook_server import (
 
 # ---- CRDs ------------------------------------------------------------
 
-def test_crds_cover_all_five_kinds_with_schemas():
+def test_crds_cover_all_six_kinds_with_schemas():
     crds = {c["metadata"]["name"]: c for c in all_crds()}
     assert set(crds) == {
-        "notebooks.kubeflow.org", "profiles.kubeflow.org",
-        "poddefaults.kubeflow.org",
+        "notebooks.kubeflow.org", "tpujobs.kubeflow.org",
+        "profiles.kubeflow.org", "poddefaults.kubeflow.org",
         "tensorboards.tensorboard.kubeflow.org",
         "pvcviewers.kubeflow.org",
     }
@@ -48,7 +48,7 @@ def test_crds_cover_all_five_kinds_with_schemas():
     # round-trips through YAML
     import yaml
     docs = list(yaml.safe_load_all(render_yaml(all_crds())))
-    assert len(docs) == 5
+    assert len(docs) == 6
 
 
 def test_notebook_crd_accelerator_enum_tracks_topology_table():

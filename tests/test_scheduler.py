@@ -425,3 +425,103 @@ def test_gang_bind_prefers_best_fragmentation_fit():
     s = cache.stats()
     assert s["largest_free_gang"] == 8.0
     assert s["free_chips"] == 8.0
+
+
+# ---- mixed-resource gangs (TPUJob: chip pods + CPU actors) -----------
+
+def _mixed_node(name: str, chips: int, cpu: int) -> dict:
+    """A node with BOTH chip and cpu allocatable — the local ``_node``
+    helper deliberately has no cpu so chip-only tests stay strict."""
+    node = _node(name, chips)
+    node["status"]["allocatable"]["cpu"] = str(cpu)
+    node["status"]["capacity"]["cpu"] = str(cpu)
+    return node
+
+
+def _cpu_pod(name: str, cpu: str, ns: str = "d") -> dict:
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": cpu}}}]}}
+
+
+def test_cpu_pods_never_charge_chips():
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_mixed_node("n0", 8, 16))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    gang = [_pod("learner-0", 8), _cpu_pod("actor-0", "4"),
+            _cpu_pod("actor-1", "4")]
+    plan = cache.gang_bind(gang, allow_virtual=False)
+    assert plan is not None and set(plan.values()) == {"n0"}
+    # the two resource axes are accounted independently
+    assert cache.node_used("n0") == 8.0
+    assert cache.node_cpu_used("n0") == 8.0
+    assert cache.stats()["free_cpu"] == 8.0
+
+    # releasing an actor gives back cpu, not chips
+    cache.release(("d", "actor-0"))
+    assert cache.node_used("n0") == 8.0
+    assert cache.node_cpu_used("n0") == 4.0
+
+
+def test_mixed_gang_partial_fit_rolls_back_both_axes():
+    """The chips fit, the cpu does not (and vice versa): either way the
+    gang is rejected with ZERO assumed binds on EITHER axis."""
+    api = APIServer()
+    api.ensure_namespace("d")
+    api.create(_mixed_node("n0", 8, 8))
+    api.create(_mixed_node("n1", 8, 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    # cpu shortfall: chips for the learner abound, 3×6 cpu does not fit
+    # 2×8 — the learner's chips must not stay held
+    gang = [_pod("l-0", 8)] + [_cpu_pod(f"a-{i}", "6") for i in range(3)]
+    assert cache.gang_bind(gang, allow_virtual=False) is None
+    # chip shortfall with plentiful cpu: same guarantee, other axis
+    gang = [_pod("l-0", 8), _pod("l-1", 8), _pod("l-2", 8),
+            _cpu_pod("a-0", "1")]
+    assert cache.gang_bind(gang, allow_virtual=False) is None
+
+    assert cache.stats()["assumed"] == 0
+    for n in ("n0", "n1"):
+        assert cache.node_used(n) == 0.0
+        assert cache.node_cpu_used(n) == 0.0
+
+
+def test_concurrent_heterogeneous_gangs_cannot_overcommit_either_axis():
+    """Racing mixed gangs must respect BOTH budgets: the fleet holds
+    two gangs by chips but only one by cpu — exactly one may win."""
+    api = APIServer()
+    api.ensure_namespace("d")
+    for i in range(2):
+        api.create(_mixed_node(f"n{i}", 8, 8))
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+
+    gangs = 8  # each: 1×8-chip learner + 2×6-cpu actors (12 cpu total)
+    barrier = threading.Barrier(gangs)
+    plans: list = [None] * gangs
+
+    def bind(i: int):
+        gang = [_pod(f"g{i}-l", 8), _cpu_pod(f"g{i}-a0", "6"),
+                _cpu_pod(f"g{i}-a1", "6")]
+        barrier.wait()
+        plans[i] = cache.gang_bind(gang, allow_virtual=False)
+
+    threads = [threading.Thread(target=bind, args=(i,))
+               for i in range(gangs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    won = [p for p in plans if p is not None]
+    # 16 cpu / 12 per gang → exactly one gang fits the cpu budget
+    assert len(won) == 1, f"{len(won)} mixed gangs admitted into 1 slot"
+    for n in ("n0", "n1"):
+        assert cache.node_used(n) <= 8.0
+        assert cache.node_cpu_used(n) <= 8.0

@@ -1,0 +1,507 @@
+"""Multi-role gang jobs (api/tpujob.py + controllers/tpujob.py):
+Podracer-style actor–learner TPUJobs — validation, the per-role
+StatefulSet/Service object graph, all-or-nothing mixed-resource gang
+binding, role-aware webhook rendezvous, whole-gang suspend/resume, hub
+conversion, and the launcher's RoleEnv contract."""
+
+import json
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import (
+    make_control_plane, metrics, scheduler, suspend,
+)
+from kubeflow_rm_tpu.controlplane.api import conversion
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
+from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
+from kubeflow_rm_tpu.controlplane.api.meta import annotations_of
+from kubeflow_rm_tpu.controlplane.api.tpujob import make_tpujob
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+    make_tpu_node,
+)
+from kubeflow_rm_tpu.controlplane.webhook.tpu_inject import (
+    TpuInjectWebhook,
+)
+from kubeflow_rm_tpu.launcher.agent import WorkerAgent, role_env
+from tests.cp_fixtures import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store():
+    suspend.set_state_store(suspend.InMemoryStateStore())
+    suspend.set_oversubscribe(True)
+    yield
+    suspend.set_oversubscribe(True)
+
+
+@pytest.fixture
+def stack():
+    """Four v5p-16 host nodes = two slices' worth of chips plus
+    4 × 96 allocatable CPUs for actor roles."""
+    clock = FakeClock()
+    api, mgr = make_control_plane(
+        clock=clock, enable_suspend=True,
+        suspend_config={"suspend_idle_minutes": 30.0,
+                        "check_period_minutes": 1.0})
+    api.ensure_namespace("rl")
+    for i in range(4):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    return api, mgr, clock
+
+
+def _podracer(name="pr", *, actors=4, learner_slices=1, cpu="2"):
+    return make_tpujob(name, "rl", roles=[
+        {"name": "learner", "replicas": learner_slices,
+         "tpu": {"acceleratorType": "v5p-16"}},
+        {"name": "actors", "replicas": actors, "cpu": cpu},
+    ])
+
+
+def _job(api, name="pr"):
+    return api.get(tj_api.KIND, name, "rl")
+
+
+def _gang_pods(api, name="pr"):
+    return api.list("Pod", "rl",
+                    {"matchLabels": {tj_api.JOB_NAME_LABEL: name}})
+
+
+def _env_of(pod):
+    return {e["name"]: e.get("value")
+            for c in pod["spec"]["containers"]
+            for e in c.get("env", [])}
+
+
+# ---- admission validation --------------------------------------------
+
+def test_validate_accepts_the_podracer_shape():
+    tj_api.validate(_podracer())
+
+
+@pytest.mark.parametrize("roles,match", [
+    ([], "at least one role"),
+    ([{"name": f"r{i}", "replicas": 1} for i in range(9)], "max 8"),
+    ([{"name": "Bad_Name", "replicas": 1}], "DNS label"),
+    ([{"name": "a", "replicas": 1}, {"name": "a", "replicas": 1}],
+     "duplicate"),
+    ([{"name": "a", "replicas": 0}], "replicas"),
+    ([{"name": "a", "replicas": "2"}], "replicas"),
+    ([{"name": "a", "replicas": 1, "cpu": "abc"}], "not a quantity"),
+    ([{"name": "a", "replicas": 1, "cpu": "-1"}], "positive"),
+], ids=["empty", "too-many", "bad-name", "dup-name", "zero-replicas",
+        "string-replicas", "bad-cpu", "negative-cpu"])
+def test_validate_rejects_bad_roles(roles, match):
+    job = make_tpujob("j", "rl", roles=[{"name": "x", "replicas": 1}])
+    job["spec"]["roles"] = roles
+    with pytest.raises(ValueError, match=match):
+        tj_api.validate(job)
+
+
+def test_validate_rejects_unknown_accelerator_and_priority():
+    bad_acc = make_tpujob("j", "rl", roles=[
+        {"name": "l", "replicas": 1,
+         "tpu": {"acceleratorType": "v99-1"}}])
+    with pytest.raises(ValueError):
+        tj_api.validate(bad_acc)
+    bad_prio = _podracer()
+    bad_prio["spec"]["priorityClassName"] = "platinum"
+    with pytest.raises(ValueError, match="priorityClassName"):
+        tj_api.validate(bad_prio)
+
+
+def test_apiserver_registers_the_validator(stack):
+    api, _, _ = stack
+    job = _podracer("inline-bad")
+    job["spec"]["roles"] = []
+    with pytest.raises(Exception, match="at least one role"):
+        api.create(job)
+
+
+# ---- the role object graph -------------------------------------------
+
+def test_controller_materialises_one_sts_and_service_per_role(stack):
+    api, mgr, _ = stack
+    api.create(_podracer())
+    mgr.run_until_idle()
+
+    learner = api.get("StatefulSet", "pr-learner", "rl")
+    actors = api.get("StatefulSet", "pr-actors", "rl")
+    # TPU role: replicas × hosts pods; CPU role: replicas pods
+    assert learner["spec"]["replicas"] == 2
+    assert actors["spec"]["replicas"] == 4
+    for sts in (learner, actors):
+        assert sts["spec"]["podManagementPolicy"] == "Parallel"
+        assert sts["spec"]["serviceName"] == sts["metadata"]["name"]
+        svc = api.get("Service", sts["metadata"]["name"], "rl")
+        assert svc["spec"]["clusterIP"] == "None"
+        assert svc["spec"]["ports"][0]["port"] == 8476
+
+    ltpl = learner["spec"]["template"]
+    assert ltpl["metadata"]["labels"][
+        nb_api.TPU_ACCELERATOR_LABEL] == "v5p-16"
+    topo = tpu_api.lookup("v5p-16")
+    sel = ltpl["spec"]["nodeSelector"]
+    assert sel[tpu_api.NODE_LABEL_ACCELERATOR] == topo.gke_accelerator
+    limits = ltpl["spec"]["containers"][0]["resources"]["limits"]
+    assert limits[tpu_api.GOOGLE_TPU_RESOURCE] == str(
+        topo.chips_per_host)
+
+    atpl = actors["spec"]["template"]
+    # CPU actors carry NO accelerator label (the webhook keys TPU env
+    # off it) but do request schedulable cpu
+    assert nb_api.TPU_ACCELERATOR_LABEL not in atpl["metadata"]["labels"]
+    reqs = atpl["spec"]["containers"][0]["resources"]["requests"]
+    assert reqs[scheduler.CPU_RESOURCE] == "2"
+
+    for tpl in (ltpl, atpl):
+        labels = tpl["metadata"]["labels"]
+        assert labels[tj_api.JOB_NAME_LABEL] == "pr"
+        assert labels[tj_api.JOB_ROLE_LABEL] in ("learner", "actors")
+        parsed = json.loads(
+            tpl["metadata"]["annotations"][tj_api.JOB_ROLES_ANNOTATION])
+        assert [r["name"] for r in parsed] == ["learner", "actors"]
+
+
+def test_gang_runs_with_role_rendezvous_env(stack):
+    api, mgr, _ = stack
+    api.create(_podracer())
+    mgr.run_until_idle()
+
+    st = _job(api)["status"]
+    assert st["phase"] == tj_api.RUNNING_PHASE
+    assert st["readyPods"] == st["totalPods"] == 6
+    assert st["roles"] == {"learner": {"ready": 2, "total": 2},
+                           "actors": {"ready": 4, "total": 4}}
+
+    pods = _gang_pods(api)
+    assert len(pods) == 6
+    assert all(p["spec"].get("nodeName") for p in pods)
+    for p in pods:
+        env = _env_of(p)
+        role = env[tj_api.ENV_JOB_ROLE]
+        assert env[tj_api.ENV_JOB_NAME] == "pr"
+        assert env[tj_api.ENV_LEARNER_ADDRESS].startswith(
+            "pr-learner-0.pr-learner.rl.svc.")
+        # TPU rendezvous is slice-scoped: learner hosts only
+        assert ("TPU_WORKER_ID" in env) == (role == "learner")
+        assert ("TPU_WORKER_HOSTNAMES" in env) == (role == "learner")
+
+    # observability satellite: the gauges follow the reconcile
+    assert metrics.registry_value("tpujob_running") >= 1.0
+    assert metrics.registry_value(
+        "tpujob_ready_pods", {"role": "actors"}) == 4.0
+
+
+def test_phase_ladder_reaches_failed_on_any_gang_pod(stack):
+    api, mgr, _ = stack
+    api.create(_podracer())
+    mgr.run_until_idle()
+    victim = _gang_pods(api)[0]
+    victim["status"] = {"phase": "Failed"}
+    api.update_status(victim)
+    mgr.run_until_idle()
+    assert _job(api)["status"]["phase"] == tj_api.FAILED_PHASE
+
+
+# ---- all-or-nothing mixed-resource gang binding ----------------------
+
+def test_gang_rolls_back_when_chips_do_not_fit(stack):
+    api, mgr, _ = stack
+    # 3 learner slices = 6 hosts, fleet has 4 → the CPU actors could
+    # fit but must NOT bind alone
+    api.create(_podracer("big", learner_slices=3))
+    mgr.run_until_idle()
+
+    pods = _gang_pods(api, "big")
+    assert pods, "role STSes should still create the pods"
+    assert all(not p["spec"].get("nodeName") for p in pods)
+    sched = scheduler.cache_for(api)
+    assert sched.stats()["assumed"] == 0
+    for i in range(4):
+        assert sched.node_used(f"n{i}") == 0.0
+        assert sched.node_cpu_used(f"n{i}") == 0.0
+    job = _job(api, "big")
+    assert job["status"]["phase"] == tj_api.PROVISIONING_PHASE
+    # the Warning surfaces on the CR itself (re-emission satellite)
+    assert any(e["reason"] == "FailedScheduling"
+               for e in api.events_for(job))
+
+
+def test_gang_rolls_back_when_cpu_does_not_fit(stack):
+    api, mgr, _ = stack
+    # chips fit easily (1 slice of 2 free) but five 90-cpu actors on
+    # four 96-cpu nodes cannot — the learner's chips must NOT stay held
+    api.create(_podracer("hungry", actors=5, cpu="90"))
+    mgr.run_until_idle()
+
+    pods = _gang_pods(api, "hungry")
+    assert pods and all(not p["spec"].get("nodeName") for p in pods)
+    sched = scheduler.cache_for(api)
+    assert sched.stats()["assumed"] == 0
+    for i in range(4):
+        assert sched.node_used(f"n{i}") == 0.0
+        assert sched.node_cpu_used(f"n{i}") == 0.0
+
+
+# ---- whole-gang suspend / resume -------------------------------------
+
+def test_suspend_parks_whole_gang_and_frees_both_resources(stack):
+    api, mgr, clock = stack
+    # 2 learner slices = the entire chip fleet
+    api.create(_podracer(learner_slices=2))
+    mgr.run_until_idle()
+    assert _job(api)["status"]["phase"] == tj_api.RUNNING_PHASE
+
+    suspend.initiate_suspend(api, _job(api), reason="manual")
+    mgr.run_until_idle()
+
+    job = _job(api)
+    ann = annotations_of(job)
+    assert nb_api.SUSPEND_DRAINED_ANNOTATION in ann
+    assert job["status"]["phase"] == tj_api.SUSPENDED_PHASE
+    assert _gang_pods(api) == []
+    for r in ("learner", "actors"):
+        assert api.get("StatefulSet", f"pr-{r}",
+                       "rl")["spec"]["replicas"] == 0
+    assert any(e["reason"] == "Suspended" for e in api.events_for(job))
+    # the release is real: a second whole-fleet gang binds NOW
+    api.create(_podracer("pr2", learner_slices=2))
+    mgr.run_until_idle()
+    assert _job(api, "pr2")["status"]["phase"] == tj_api.RUNNING_PHASE
+
+
+def test_resume_restores_the_gang_atomically(stack):
+    api, mgr, clock = stack
+    api.create(_podracer(learner_slices=2))
+    mgr.run_until_idle()
+    suspend.initiate_suspend(api, _job(api), reason="manual")
+    mgr.run_until_idle()
+    assert _gang_pods(api) == []
+
+    suspend.request_resume(api, _job(api))
+    mgr.run_until_idle()
+
+    job = _job(api)
+    ann = annotations_of(job)
+    st = job["status"]
+    assert st["phase"] == tj_api.RUNNING_PHASE
+    assert st["readyPods"] == st["totalPods"] == 8
+    # every role back at once — no half-gang is ever Running
+    assert st["roles"] == {"learner": {"ready": 4, "total": 4},
+                           "actors": {"ready": 4, "total": 4}}
+    for key in (nb_api.SUSPEND_ANNOTATION,
+                nb_api.RESUME_REQUESTED_ANNOTATION,
+                nb_api.SUSPEND_DRAINED_ANNOTATION,
+                nb_api.SUSPEND_CHECKPOINT_ANNOTATION):
+        assert key not in ann
+    assert any(e["reason"] == "Resumed" for e in api.events_for(job))
+
+
+def test_bare_resume_requested_stamp_unparks_the_gang(stack):
+    """A REST arm may stamp RESUME_REQUESTED without clearing SUSPEND;
+    the controller owns popping it and still resumes whole."""
+    api, mgr, clock = stack
+    api.create(_podracer())
+    mgr.run_until_idle()
+    suspend.initiate_suspend(api, _job(api), reason="manual")
+    mgr.run_until_idle()
+
+    job = _job(api)
+    job["metadata"]["annotations"][
+        nb_api.RESUME_REQUESTED_ANNOTATION] = api.clock().isoformat()
+    api.update(job)
+    mgr.run_until_idle()
+
+    job = _job(api)
+    assert job["status"]["phase"] == tj_api.RUNNING_PHASE
+    assert nb_api.SUSPEND_ANNOTATION not in annotations_of(job)
+    assert nb_api.RESUME_REQUESTED_ANNOTATION not in annotations_of(job)
+
+
+def test_suspended_gang_never_half_resumes_under_contention(stack):
+    """Resume while ANOTHER gang holds the chips: the parked job must
+    stay entirely parked (actors could fit — they must not start)."""
+    api, mgr, clock = stack
+    api.create(_podracer(learner_slices=2))
+    mgr.run_until_idle()
+    suspend.initiate_suspend(api, _job(api), reason="manual")
+    mgr.run_until_idle()
+    api.create(_podracer("squatter", learner_slices=2))
+    mgr.run_until_idle()
+    assert _job(api, "squatter")["status"]["phase"] == \
+        tj_api.RUNNING_PHASE
+
+    suspend.request_resume(api, _job(api))
+    mgr.run_until_idle()
+
+    pods = _gang_pods(api)
+    # pods may exist (the STSes scaled back up) but NONE may be bound
+    assert all(not p["spec"].get("nodeName") for p in pods)
+    assert _job(api)["status"]["phase"] != tj_api.RUNNING_PHASE
+    # the squatter's gang is untouched
+    assert _job(api, "squatter")["status"]["readyPods"] == 8
+
+
+# ---- webhook role injection (unit) -----------------------------------
+
+_ROLES_JSON = json.dumps([
+    {"name": "learner", "pods": 2, "service": "pr-learner",
+     "tpu": "v5p-16"},
+    {"name": "actors", "pods": 4, "service": "pr-actors", "tpu": None},
+], separators=(",", ":"))
+
+
+def _gang_pod(name, role, *, acc=None, env=None):
+    labels = {tj_api.JOB_NAME_LABEL: "pr",
+              tj_api.JOB_ROLE_LABEL: role,
+              "statefulset.kubernetes.io/pod-name": name}
+    if acc:
+        labels[nb_api.TPU_ACCELERATOR_LABEL] = acc
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": name, "namespace": "rl", "labels": labels,
+                "annotations": {
+                    tj_api.JOB_ROLES_ANNOTATION: _ROLES_JSON}},
+            "spec": {"subdomain": f"pr-{role}",
+                     "containers": [{"name": "main",
+                                     "env": list(env or [])}]}}
+
+
+@pytest.fixture
+def webhook():
+    api, _ = make_control_plane()
+    return TpuInjectWebhook(api)
+
+
+def test_webhook_actor_gets_role_env_but_no_tpu_env(webhook):
+    out = webhook("CREATE", _gang_pod("pr-actors-2", "actors"), None)
+    assert out is not None
+    env = _env_of(out)
+    assert env[tj_api.ENV_JOB_NAME] == "pr"
+    assert env[tj_api.ENV_JOB_ROLE] == "actors"
+    assert env[tj_api.ENV_JOB_ROLE_INDEX] == "2"
+    assert env[tj_api.ENV_JOB_ROLE_HOSTNAMES].count(",") == 3
+    assert env[tj_api.ENV_JOB_HOSTNAMES_PREFIX + "LEARNER"] == (
+        "pr-learner-0.pr-learner.rl.svc.cluster.local,"
+        "pr-learner-1.pr-learner.rl.svc.cluster.local")
+    assert env[tj_api.ENV_LEARNER_ADDRESS] == \
+        "pr-learner-0.pr-learner.rl.svc.cluster.local"
+    # the TPU-scoped contract stays off chipless pods
+    for var in ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
+                "TPU_ACCELERATOR_TYPE", "TPU_TOPOLOGY"):
+        assert var not in env
+    assert not out["spec"].get("volumes")
+
+
+def test_webhook_chip_pod_gets_role_env_and_tpu_env(webhook):
+    out = webhook("CREATE",
+                  _gang_pod("pr-learner-1", "learner", acc="v5p-16"),
+                  None)
+    env = _env_of(out)
+    assert env[tj_api.ENV_JOB_ROLE] == "learner"
+    assert env[tj_api.ENV_LEARNER_ADDRESS].startswith("pr-learner-0.")
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 2
+
+
+def test_webhook_preserves_user_set_role_env(webhook):
+    pod = _gang_pod("pr-actors-0", "actors",
+                    env=[{"name": tj_api.ENV_LEARNER_ADDRESS,
+                          "value": "custom:1234"}])
+    env = _env_of(webhook("CREATE", pod, None))
+    assert env[tj_api.ENV_LEARNER_ADDRESS] == "custom:1234"
+
+
+def test_webhook_ignores_plain_cpu_pods(webhook):
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "web-0", "namespace": "rl",
+                        "labels": {}},
+           "spec": {"containers": [{"name": "c"}]}}
+    assert webhook("CREATE", pod, None) is None
+
+
+# ---- hub conversion --------------------------------------------------
+
+@pytest.mark.parametrize("spoke", ["v1beta1", "v1alpha1"])
+def test_tpujob_conversion_round_trips(spoke):
+    job = _podracer()
+    down = conversion.convert_tpujob(job, spoke)
+    assert down["apiVersion"].endswith(spoke)
+    assert "roles" not in (down.get("spec") or {})
+    ann = down["metadata"]["annotations"]
+    assert [r["name"] for r in
+            json.loads(ann[conversion.TPU_JOB_ROLES_ANNOTATION])] == \
+        ["learner", "actors"]
+    back = conversion.convert_tpujob(down, "v1")
+    assert back["spec"]["roles"] == job["spec"]["roles"]
+    assert conversion.TPU_JOB_ROLES_ANNOTATION not in (
+        back["metadata"].get("annotations") or {})
+
+
+def test_tpujob_convert_review_wire_protocol():
+    review = {"apiVersion": "apiextensions.k8s.io/v1",
+              "kind": "ConversionReview",
+              "request": {"uid": "u-1",
+                          "desiredAPIVersion": "kubeflow.org/v1beta1",
+                          "objects": [_podracer()]}}
+    resp = conversion.convert_review(review)["response"]
+    assert resp["uid"] == "u-1"
+    assert resp["result"]["status"] == "Success"
+    got = resp["convertedObjects"][0]
+    assert got["apiVersion"] == "kubeflow.org/v1beta1"
+    assert conversion.TPU_JOB_ROLES_ANNOTATION in \
+        got["metadata"]["annotations"]
+
+
+def test_tpujob_conversion_rejects_bad_annotation_json():
+    bad = make_tpujob("j", "rl", roles=[{"name": "a", "replicas": 1}])
+    bad = conversion.convert_tpujob(bad, "v1beta1")
+    bad["metadata"]["annotations"][
+        conversion.TPU_JOB_ROLES_ANNOTATION] = "{not json"
+    with pytest.raises(ValueError, match="not valid JSON"):
+        conversion.convert_tpujob(bad, "v1")
+
+
+# ---- launcher RoleEnv ------------------------------------------------
+
+def test_role_env_parses_the_webhook_contract():
+    e = {
+        tj_api.ENV_JOB_NAME: "pr",
+        tj_api.ENV_JOB_ROLE: "actors",
+        tj_api.ENV_JOB_ROLE_INDEX: "3",
+        tj_api.ENV_JOB_ROLE_HOSTNAMES: "a-0.x,a-1.x",
+        tj_api.ENV_JOB_HOSTNAMES_PREFIX + "LEARNER": "l-0.x,l-1.x",
+        tj_api.ENV_JOB_HOSTNAMES_PREFIX + "EVAL_ACTORS": "e-0.x",
+        tj_api.ENV_LEARNER_ADDRESS: "l-0.x",
+    }
+    r = role_env(e)
+    assert r.in_gang
+    assert (r.job, r.role, r.role_index) == ("pr", "actors", 3)
+    assert r.role_hostnames == ("a-0.x", "a-1.x")
+    # env suffixes map back to the DNS-label role names
+    assert r.peers["learner"] == ("l-0.x", "l-1.x")
+    assert r.peers["eval-actors"] == ("e-0.x",)
+    assert r.learner_address == "l-0.x"
+
+
+def test_role_env_never_raises():
+    assert not role_env({}).in_gang
+    r = role_env({tj_api.ENV_JOB_NAME: "j",
+                  tj_api.ENV_JOB_ROLE_INDEX: "not-a-number"})
+    assert r.in_gang and r.role_index == 0
+
+
+def test_worker_agent_distinguishes_actor_from_chip_member():
+    actor = WorkerAgent({tj_api.ENV_JOB_NAME: "pr",
+                         tj_api.ENV_JOB_ROLE: "actors"})
+    assert actor.is_actor
+    chip = WorkerAgent({tj_api.ENV_JOB_NAME: "pr",
+                        tj_api.ENV_JOB_ROLE: "learner",
+                        "TPU_ACCELERATOR_TYPE": "v5p-16",
+                        "TPU_WORKER_ID": "0",
+                        "TPU_WORKER_HOSTNAMES": "h0"})
+    assert not chip.is_actor
+    solo = WorkerAgent({})
+    assert not solo.is_actor

@@ -357,8 +357,8 @@ class Walk:
         finally:
             try:
                 self.api.delete("Notebook", "denied", NS)
-            except Exception:
-                pass
+            except NotFound:
+                pass  # admission may have rejected the create outright
             self.api.delete("ResourceQuota", "tiny-quota", NS)
         return {"quota_chips": chips,
                 "slice_chips": chips * self.hosts}

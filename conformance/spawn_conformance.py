@@ -903,7 +903,20 @@ def main() -> int:
     ap.add_argument("--out", default="",
                     help="also write the result JSON to this file "
                          "(PROVISION_r{N}.json artifact)")
+    ap.add_argument("--lock-analysis", action="store_true",
+                    help="run the storm under the instrumented lock "
+                         "factory (analysis/lockgraph) and fail on "
+                         "lock-order cycles, rank inversions, "
+                         "hierarchy violations, or blocking calls "
+                         "under hot locks; set KFRM_LOCK_ANALYSIS=1 "
+                         "too so module-level locks are covered")
+    ap.add_argument("--lockgraph-out", default="",
+                    help="write the lockgraph report JSON here "
+                         "(LOCKGRAPH_r{N}.json artifact)")
     args = ap.parse_args()
+    if args.lock_analysis:
+        from kubeflow_rm_tpu.analysis import lockgraph
+        lockgraph.set_enabled(True)
     # module-level switch: covers every Manager in this process (the
     # platform manager AND the wallclock kubelet both import runtime)
     from kubeflow_rm_tpu.controlplane import runtime, scheduler, suspend
@@ -916,7 +929,7 @@ def main() -> int:
         import faulthandler
         faulthandler.dump_traceback_later(args.hang_dump, exit=True)
     if args.wallclock:
-        return wallclock_main(args)
+        return wallclock_main(args) or _lockgraph_gate(args)
 
     # suspend lifecycle controller on, idle parking off: explicit API
     # suspends work, spawn-path behavior is otherwise unchanged
@@ -1083,6 +1096,52 @@ def main() -> int:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=1)
     print("CONFORMANCE OK")
+    return _lockgraph_gate(args)
+
+
+# locks on the spawn/reconcile hot path: a blocking syscall observed
+# while one is held is a latency bug (the snapshot path's rotate under
+# apiserver.write_log is the one documented, deliberate exception —
+# see proposals/20260805-concurrency-analysis.md)
+HOT_LOCK_PREFIXES = ("apiserver.kind", "scheduler.", "cache.store",
+                     "runtime.", "workqueue", "readiness.")
+
+
+def _lockgraph_gate(args) -> int:
+    """When the storm ran under ``--lock-analysis``: dump the measured
+    lock graph and fail the run on any concurrency-correctness
+    violation the dynamic analysis can witness."""
+    from kubeflow_rm_tpu.analysis import lockgraph
+    from kubeflow_rm_tpu.analysis.hierarchy import check_edges
+    if not lockgraph.enabled():
+        return 0
+    rep = lockgraph.report()
+    if args.lockgraph_out:
+        with open(args.lockgraph_out, "w") as f:
+            json.dump(rep, f, indent=1)
+    problems = []
+    for c in rep["cycles"]:
+        problems.append(
+            "lock-order cycle: " + " <-> ".join(c["locks"]))
+    for v in rep["order_violations"]:
+        problems.append(
+            f"rank inversion in {v['group']}: held {v['held_rank']} "
+            f"then acquired {v['acquired_rank']} (x{v['count']})")
+    problems.extend(check_edges(rep["edges"]))
+    for b in rep["blocking_under_lock"]:
+        if any(h.startswith(HOT_LOCK_PREFIXES) for h in b["held"]):
+            problems.append(
+                f"blocking {b['op']} under hot lock(s) "
+                f"{','.join(b['held'])} (x{b['count']})\n"
+                f"    {b['witness']}")
+    if problems:
+        print("LOCKGRAPH GATE FAILED:", file=sys.stderr)
+        for p in problems:
+            print("  " + p, file=sys.stderr)
+        return 3
+    print(f"LOCKGRAPH OK ({len(rep['locks'])} locks, "
+          f"{len(rep['edges'])} edges, 0 cycles, 0 hot-lock blocking)",
+          file=sys.stderr)
     return 0
 
 

@@ -1,0 +1,28 @@
+"""Concurrency correctness toolkit for the control plane.
+
+Two prongs, both grown out of the same problem: the platform is a
+sharded, multi-process, multi-threaded control plane with ~40
+lock instances whose deadlock-freedom and "never block under a hot
+lock" invariants were, until r14, enforced only by docstrings.
+
+- :mod:`kubeflow_rm_tpu.analysis.lockgraph` — a dynamic, opt-in
+  (``KFRM_LOCK_ANALYSIS=1``) instrumented lock factory every
+  control-plane module uses in place of bare ``threading`` primitives.
+  When off it hands back raw primitives (zero cost); when on it
+  records per-thread held-sets, builds the global acquisition-order
+  graph, detects cycles (potential deadlocks) with witness stacks,
+  flags blocking syscalls executed while holding a registered lock,
+  and reports per-lock held-time percentiles.
+
+- :mod:`kubeflow_rm_tpu.analysis.lint` — a static AST lint
+  (``python -m kubeflow_rm_tpu.analysis.lint kubeflow_rm_tpu/``)
+  that ratchets the conventions the dynamic tool verifies: KFRM001
+  no raw lock construction outside the factory, KFRM002 no blocking
+  call lexically under a lock, KFRM003 manual ``.acquire()`` needs a
+  ``try/finally`` release, KFRM004 no apiserver/kubeclient write
+  under a kind lock, KFRM005 ``except Exception:`` must log or count.
+
+- :mod:`kubeflow_rm_tpu.analysis.hierarchy` — the canonical lock
+  hierarchy, in one importable place; tests assert the measured
+  acquisition graph embeds into it.
+"""

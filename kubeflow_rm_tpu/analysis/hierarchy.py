@@ -1,0 +1,109 @@
+"""The canonical lock hierarchy — ONE place, not N docstrings.
+
+A thread may only acquire a lock whose level is **strictly greater**
+than every lock it already holds, except within a ranked same-name
+family (level ties with itself), where instances must be acquired in
+ascending ``rank`` order. Any acquisition-order edge that runs
+*downhill* is a latent deadlock even if no cycle has been observed
+yet; ``tests/test_lockgraph.py`` asserts the measured graph from a
+control-plane storm embeds into this table, and the table is the
+review reference for every new lock.
+
+Levels are spaced by 10 so a new lock slots in without renumbering.
+
+Notes on the non-obvious entries:
+
+- ``apiserver.kind`` is one *family* (one RLock per kind). Cross-kind
+  nesting follows the ownerReference DAG (owner's kind lock held
+  while a dependent's is taken: Notebook → StatefulSet → Pod,
+  Namespace → everything at drain). The DAG is acyclic for every
+  object graph the platform builds, so the family sits at one level
+  and the dynamic tool watches the per-kind edges for cycles.
+- ``scheduler.node`` is the ranked family: ``_commit`` acquires the
+  gang's node locks sorted by node name (= the rank), under
+  ``scheduler.relist``.
+- The WAL condvar is at the bottom: with the r14 deferred group
+  commit the fsync wait happens with NO other lock held (the verb's
+  kind lock is released first), so ``wal.cv`` must never be held
+  while taking anything above it.
+- ``readiness.registry`` → ``readiness.key``: the hub registers and
+  retires per-key waiters under the registry lock.
+"""
+
+from __future__ import annotations
+
+#: lock-family name (the ``make_lock`` label) -> hierarchy level.
+LOCK_HIERARCHY: dict[str, int] = {
+    # -- coarse, outermost ---------------------------------------------
+    "apiserver.global": 10,         # legacy --global-lock arm verb lock
+    "scheduler.registry": 20,       # per-backend cache registry
+    "scheduler.relist": 30,         # rebuild vs bind-commit exclusion
+    "scheduler.nodes_map": 40,      # node-map membership
+    "scheduler.node": 50,           # ranked family: sorted by node name
+    "scheduler.pods_map": 60,       # pod -> entry accounting map
+    # -- apiserver write path ------------------------------------------
+    "apiserver.kind": 110,          # per-kind verb locks (DAG inside)
+    "apiserver.kind_locks_map": 120,
+    "apiserver.event_seq": 130,     # atomic Event name counter
+    "apiserver.write_log": 140,     # write audit append
+    "apiserver.pod_logs": 140,      # kubelet stdout store
+    # rv sits BELOW write_log: the snapshot cut reads the rv counter
+    # while holding the write lock (_run_snapshot), never the reverse
+    "apiserver.rv": 145,            # atomic resourceVersion counter
+    "apiserver.admission_pool": 150,
+    "apiserver.watch_channel": 160,  # per-watcher fanout condvar
+    # -- controller runtime / HA ---------------------------------------
+    "runtime.queue": 210,
+    "runtime.child_pool": 220,
+    "workqueue": 230,
+    "leases.elector": 240,
+    "informer.prime": 250,
+    "cache.store": 260,             # ObjectStore RLock + its condvar
+    # -- transport / web -----------------------------------------------
+    "kubeclient.token_bucket": 310,
+    "kubeclient.conn_pool": 320,
+    "kubeclient.events_seen": 330,
+    "kubeclient.router_listed": 340,
+    "restserver.watch_registry": 350,
+    "restserver.conns": 360,
+    "shard.watchdog": 370,
+    "readiness.registry": 410,
+    "readiness.key": 420,           # per-notebook condvar family
+    "jupyter.hub_registry": 430,
+    "serving.gateway": 440,
+    "metrics_service.sampler_thread": 450,  # lazy sampler-thread start
+    "metrics_service.sampler": 460,         # the history ring
+    "tracing.collector": 510,
+    # -- persistence, innermost ----------------------------------------
+    "persistence.snapshot_guard": 610,
+    "wal.cv": 620,                  # group-commit condvar; leaf
+}
+
+
+def level_of(name: str) -> int | None:
+    return LOCK_HIERARCHY.get(name)
+
+
+def check_edges(edges) -> list[str]:
+    """Validate measured acquisition-order edges (``{"from", "to"}``
+    dicts from :func:`lockgraph.report`) against the hierarchy.
+    Returns human-readable violations: downhill edges (held a
+    higher-level lock while taking a lower-level one) and edges whose
+    endpoints are unregistered (a new lock missing from the table)."""
+    problems = []
+    for e in edges:
+        a, b = e["from"], e["to"]
+        la, lb = LOCK_HIERARCHY.get(a), LOCK_HIERARCHY.get(b)
+        if la is None:
+            problems.append(f"unregistered lock in hierarchy: {a}")
+            continue
+        if lb is None:
+            problems.append(f"unregistered lock in hierarchy: {b}")
+            continue
+        if a == b:
+            continue  # ranked-family nesting is checked by rank order
+        if lb <= la:
+            problems.append(
+                f"downhill acquisition {a} (level {la}) -> {b} "
+                f"(level {lb}): violates the canonical order")
+    return sorted(set(problems))

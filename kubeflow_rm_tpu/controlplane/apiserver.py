@@ -37,6 +37,11 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     strategic_merge,
 )
 from kubeflow_rm_tpu.controlplane import tracing
+from kubeflow_rm_tpu.analysis.lockgraph import (
+    make_condition,
+    make_lock,
+    make_rlock,
+)
 
 CLUSTER_SCOPED_KINDS = {
     "Namespace", "Profile", "Node", "ClusterRole", "ClusterRoleBinding",
@@ -92,7 +97,7 @@ _EMPTY: dict = {}
 # sharded mode (plugins only read, and sharded reads are lock-free);
 # lazily built so import stays thread-free
 _admission_pool = None
-_admission_pool_guard = threading.Lock()
+_admission_pool_guard = make_lock("apiserver.admission_pool")
 
 
 def _bulk_admission_pool():
@@ -145,7 +150,9 @@ class _WatcherChannel:
         self.name = name
         self.maxlen = maxlen
         self._q: collections.deque = collections.deque()
-        self._cond = threading.Condition(threading.Lock())
+        self._cond = make_condition(
+            "apiserver.watch_channel",
+            lock=make_lock("apiserver.watch_channel"))
         self._thread: threading.Thread | None = None
         self._busy = False  # a callback is in flight
         self.overflows = 0
@@ -272,11 +279,11 @@ class APIServer:
         # synchronously inside the write path — as the A/B baseline
         # arm (`spawn_conformance --global-lock`).
         self._global = global_lock
-        self._lock = threading.RLock()  # the global-arm verb lock
+        self._lock = make_rlock("apiserver.global")  # global-arm verb lock
         self._locks: dict[str, threading.RLock] = {}
-        self._locks_guard = threading.Lock()
-        self._rv_lock = threading.Lock()
-        self._seq_lock = threading.Lock()
+        self._locks_guard = make_lock("apiserver.kind_locks_map")
+        self._rv_lock = make_lock("apiserver.rv")
+        self._seq_lock = make_lock("apiserver.event_seq")
         self._watch_queue_maxlen = watch_queue_maxlen
         # per-kind working dicts (kind -> {full key: obj}) — mutated
         # only under that kind's lock — plus the published COW
@@ -296,7 +303,7 @@ class APIServer:
         # kubelet appends boot lines, the `pods/<name>/log` subresource
         # reads them — ref jupyter backend get_pod_logs)
         self._pod_logs: dict[tuple[str, str], list[str]] = {}
-        self._pod_log_lock = threading.Lock()
+        self._pod_log_lock = make_lock("apiserver.pod_logs")
         # bounded audit trail of writes, tagged with the writer identity
         # set via set_writer (the REST facade stamps it from the
         # X-Writer-Identity header). The failover conformance asserts
@@ -304,7 +311,7 @@ class APIServer:
         # write lands, the dead leader must never write again.
         self.write_log: collections.deque = collections.deque(maxlen=8192)
         self._write_seq = 0
-        self._write_lock = threading.Lock()
+        self._write_lock = make_lock("apiserver.write_log")
         self._writer = threading.local()
         # ---- durability (persistence/: WAL + compacting snapshots) --
         # wal_dir=None (the default, and the --no-wal arm) keeps the
@@ -315,7 +322,7 @@ class APIServer:
         # store and resumes its rv sequence (no duplicate watch events
         # — watchers attach after replay, which emits nothing).
         self._persistence = None
-        self._wal_tls = threading.local()  # create_many batch flag
+        self._wal_tls = threading.local()  # _write_verb depth + ticket
         if wal_dir:
             from kubeflow_rm_tpu.controlplane.persistence import (
                 Persistence,
@@ -395,7 +402,8 @@ class APIServer:
         lk = self._locks.get(kind)
         if lk is None:
             with self._locks_guard:
-                lk = self._locks.setdefault(kind, threading.RLock())
+                lk = self._locks.setdefault(
+                    kind, make_rlock("apiserver.kind"))
         return lk
 
     def _read_lock(self):
@@ -456,30 +464,47 @@ class APIServer:
             })
         p = self._persistence
         if p is not None:
-            # durable before ack: the verb holds only its kind lock
-            # here, so one kind's fsync wait never blocks another
-            # kind's writes, and concurrent writers share one group
-            # commit. create_many defers the wait to a single
-            # batch-level flush (one fsync per slice, not per pod).
-            p.log(seq=seq, rv=rv, verb=verb, obj=obj,
-                  wait=not getattr(self._wal_tls, "batch", False))
+            # durable before ack, but never fsync under the kind lock:
+            # the record is buffered here (cheap — wal.cv only) and its
+            # ticket accumulated on the thread; the enclosing
+            # _write_verb flushes AFTER the kind lock is released, so
+            # one kind's fsync wait never blocks that kind's (or any
+            # other kind's) writers, and concurrent verbs share one
+            # group commit. create_many's whole batch rides one flush.
+            ticket = p.log(seq=seq, rv=rv, verb=verb, obj=obj,
+                           wait=False)
+            tls = self._wal_tls
+            if getattr(tls, "depth", 0) > 0:
+                tls.ticket = max(getattr(tls, "ticket", 0), ticket)
+            else:
+                # defensive: a write outside any _write_verb still
+                # acks only after durability
+                p.flush(upto=ticket)
             if p.snapshot_due() and p.begin_snapshot():
                 threading.Thread(target=self._run_snapshot, daemon=True,
                                  name="wal-snapshot").start()
 
     @contextlib.contextmanager
-    def _wal_batch(self):
-        """Defer WAL durability waits inside the block; one flush at
-        exit makes the whole batch durable with one group commit."""
-        if self._persistence is None:
-            yield
-            return
-        self._wal_tls.batch = True
+    def _write_verb(self, kind: str):
+        """The kind lock plus deferred WAL durability: records logged
+        inside the block are fsynced once, AFTER the lock is released,
+        and the verb returns only when they are durable. Reentrant —
+        nested verbs (patch → update, ensure_namespace → create,
+        cascading deletes across kinds) accumulate tickets and the
+        outermost exit does the single flush, outside every lock."""
+        tls = self._wal_tls
+        depth = getattr(tls, "depth", 0)
+        tls.depth = depth + 1
         try:
-            yield
+            with self._kind_lock(kind):
+                yield
         finally:
-            self._wal_tls.batch = False
-            self._persistence.flush()
+            tls.depth = depth
+            if depth == 0:
+                ticket = getattr(tls, "ticket", 0)
+                tls.ticket = 0
+                if ticket and self._persistence is not None:
+                    self._persistence.flush(upto=ticket)
 
     def _run_snapshot(self) -> None:
         """Cut a consistent snapshot and compact the WAL. The cut +
@@ -551,7 +576,7 @@ class APIServer:
         return obj
 
     def ensure_namespace(self, namespace: str) -> dict:
-        with self._kind_lock("Namespace"):
+        with self._write_verb("Namespace"):
             try:
                 return self.get("Namespace", namespace)
             except NotFound:
@@ -568,7 +593,7 @@ class APIServer:
         tracing.stamp(obj)
         kind = obj["kind"]
         name, ns = name_of(obj), namespace_of(obj)
-        with self._kind_lock(kind):
+        with self._write_verb(kind):
             if kind in CLUSTER_SCOPED_KINDS:
                 ns = None
                 obj["metadata"].pop("namespace", None)
@@ -658,7 +683,7 @@ class APIServer:
             else:
                 admitted[i] = self._run_admission("CREATE", o, None)
 
-        with self._kind_lock(kind):
+        with self._write_verb(kind):
             if self._global or len(objs) == 1:
                 for i in range(len(objs)):
                     try:
@@ -670,42 +695,44 @@ class APIServer:
                         for i in range(len(objs))]
                 for i, fut in enumerate(futs):
                     try:
-                        fut.result()
+                        # deliberate wait under the kind lock: holding
+                        # it across parallel admission is the batch's
+                        # point (one atomic slice); plugins only read
+                        fut.result()  # kfrm: disable=KFRM002
                     except APIError as e:
                         results[i] = status_from_error(e)
             pending = [i for i in range(len(objs)) if results[i] is None]
             rvs = self._next_rvs(len(pending))
             created: list[dict] = []
-            with self._wal_batch():
-                for j, i in enumerate(pending):
-                    o = admitted[i]
-                    name = name_of(o)
-                    ns = None if kind in CLUSTER_SCOPED_KINDS \
-                        else namespace_of(o)
-                    key = self._key(kind, name, ns)
-                    try:
-                        if key in self._by_kind.get(kind, _EMPTY):
-                            raise AlreadyExists(
-                                f"{kind} {ns}/{name} already exists")
-                        if self.quota_enforcement and kind == "Pod":
-                            self._enforce_quota(o)
-                    except APIError as e:
-                        results[i] = status_from_error(e)
-                        m_obj.labels(kind=kind, result="rejected").inc()
-                        continue
-                    meta = o["metadata"]
-                    meta["uid"] = new_uid()
-                    meta["resourceVersion"] = rvs[j]
-                    meta["creationTimestamp"] = self.clock().isoformat()
-                    self._by_kind.setdefault(kind, {})[key] = o
-                    # publish per insert (cheap shallow copy) so the
-                    # quota scan for the NEXT batch-mate sees this one;
-                    # the watch emit below stays one coalesced batch
-                    self._publish(kind)
-                    self._log_write("CREATE", o)
-                    results[i] = _fastcopy(o)
-                    created.append(o)
-                    m_obj.labels(kind=kind, result="created").inc()
+            for j, i in enumerate(pending):
+                o = admitted[i]
+                name = name_of(o)
+                ns = None if kind in CLUSTER_SCOPED_KINDS \
+                    else namespace_of(o)
+                key = self._key(kind, name, ns)
+                try:
+                    if key in self._by_kind.get(kind, _EMPTY):
+                        raise AlreadyExists(
+                            f"{kind} {ns}/{name} already exists")
+                    if self.quota_enforcement and kind == "Pod":
+                        self._enforce_quota(o)
+                except APIError as e:
+                    results[i] = status_from_error(e)
+                    m_obj.labels(kind=kind, result="rejected").inc()
+                    continue
+                meta = o["metadata"]
+                meta["uid"] = new_uid()
+                meta["resourceVersion"] = rvs[j]
+                meta["creationTimestamp"] = self.clock().isoformat()
+                self._by_kind.setdefault(kind, {})[key] = o
+                # publish per insert (cheap shallow copy) so the
+                # quota scan for the NEXT batch-mate sees this one;
+                # the watch emit below stays one coalesced batch
+                self._publish(kind)
+                self._log_write("CREATE", o)
+                results[i] = _fastcopy(o)
+                created.append(o)
+                m_obj.labels(kind=kind, result="created").inc()
             for i in range(len(objs)):
                 if results[i] is not None and is_status(results[i]) \
                         and admitted[i] is None:
@@ -769,7 +796,7 @@ class APIServer:
         if kind in CLUSTER_SCOPED_KINDS:
             ns = None
         key = self._key(kind, name, ns)
-        with self._kind_lock(kind):
+        with self._write_verb(kind):
             working = self._by_kind.get(kind, _EMPTY)
             if key not in working:
                 raise NotFound(f"{kind} {ns}/{name} not found")
@@ -807,7 +834,7 @@ class APIServer:
 
     def patch(self, kind: str, name: str, patch: dict,
               namespace: str | None = None) -> dict:
-        with self._kind_lock(kind):
+        with self._write_verb(kind):
             current = self.get(kind, name, namespace)
             merged = strategic_merge(current, patch)
             merged["metadata"]["resourceVersion"] = \
@@ -816,7 +843,7 @@ class APIServer:
 
     def update_status(self, obj: dict) -> dict:
         """Status-subresource write: only ``status`` is applied."""
-        with self._kind_lock(obj["kind"]):
+        with self._write_verb(obj["kind"]):
             current = self.get(obj["kind"], name_of(obj),
                                namespace_of(obj))
             current["status"] = _fastcopy(obj.get("status", {}))
@@ -824,7 +851,7 @@ class APIServer:
 
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
         key = self._key(kind, name, namespace)
-        with self._kind_lock(kind):
+        with self._write_verb(kind):
             working = self._by_kind.get(kind, _EMPTY)
             if key not in working:
                 raise NotFound(f"{kind} {namespace}/{name} not found")

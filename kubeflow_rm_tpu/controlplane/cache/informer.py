@@ -29,6 +29,7 @@ import time
 from typing import Iterable
 
 from kubeflow_rm_tpu.controlplane.cache.store import ObjectStore
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 log = logging.getLogger("kubeflow_rm_tpu.cache")
 
@@ -52,7 +53,7 @@ class SharedInformer:
         # are reconciled by replace()'s rv horizon); a remote backend
         # must sync through its watch threads
         self.lazy = not hasattr(api, "watch_kind")
-        self._prime_lock = threading.Lock()
+        self._prime_lock = make_lock("informer.prime")
         self._threads: list[threading.Thread] = []
 
     # ---- event feed (in-memory backend) ------------------------------
@@ -85,15 +86,16 @@ class SharedInformer:
             return True
         if not self.lazy:
             return False
+        from kubeflow_rm_tpu.controlplane import metrics
         with self._prime_lock:
             if self.store.is_synced(kind):
                 return True
             try:
                 objs = self.api.list(kind)
             except Exception:  # noqa: BLE001 - kind may not be served
+                metrics.swallowed("informer", f"lazy prime list {kind}")
                 return False
             self.store.replace(kind, objs)
-            from kubeflow_rm_tpu.controlplane import metrics
             metrics.INFORMER_SYNCED_KINDS.set(
                 len(self.store.synced_kinds()))
         return True

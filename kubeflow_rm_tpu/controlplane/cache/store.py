@@ -37,6 +37,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     name_of,
     namespace_of,
 )
+from kubeflow_rm_tpu.analysis.lockgraph import make_condition, make_rlock
 
 # same scope table the in-memory apiserver and the kube adapter's REST
 # mapping use — a cluster-scoped object is keyed under namespace None
@@ -59,8 +60,8 @@ def rv_of(obj: dict | None) -> int:
 
 class ObjectStore:
     def __init__(self, cluster_scoped: set[str] | None = None):
-        self._lock = threading.RLock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = make_rlock("cache.store")
+        self._cond = make_condition("cache.store", lock=self._lock)
         self._cluster_scoped = cluster_scoped or CLUSTER_SCOPED_KINDS
         # kind -> {(ns, name): obj}
         self._by_kind: dict[str, dict[tuple, dict]] = {}

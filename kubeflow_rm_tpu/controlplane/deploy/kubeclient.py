@@ -45,7 +45,8 @@ from kubeflow_rm_tpu.controlplane.apiserver import (
     NotFound,
     status_from_error,
 )
-from kubeflow_rm_tpu.controlplane import tracing
+from kubeflow_rm_tpu.controlplane import metrics, tracing
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 log = logging.getLogger("kubeflow_rm_tpu.kubeclient")
 
@@ -134,7 +135,7 @@ class TokenBucket:
         self._sleep = sleep or _time.sleep
         self._tokens = float(self.burst)
         self._last = self._clock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("kubeclient.token_bucket")
         # total seconds of wait injected — surfaced for conformance
         self.throttled_seconds = 0.0
         self.throttled_calls = 0
@@ -207,14 +208,14 @@ class _Resp:
         try:
             self.raw.close()
         except Exception:
-            pass
+            metrics.swallowed("kubeclient", "stream close")
 
 
 def _close_quietly(conn) -> None:
     try:
         conn.close()
     except Exception:
-        pass
+        metrics.swallowed("kubeclient", "conn close")
 
 
 class _ConnPool:
@@ -231,7 +232,7 @@ class _ConnPool:
     def __init__(self, max_idle: int = 16):
         self.max_idle = max_idle
         self._idle: list = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("kubeclient.conn_pool")
         self.dials = 0    # fresh connections established
         self.reuses = 0   # requests served on a pooled connection
 
@@ -251,7 +252,7 @@ class _ConnPool:
         try:
             conn.close()
         except Exception:
-            pass
+            metrics.swallowed("kubeclient", "pool checkin close")
 
     def close(self) -> None:
         with self._lock:
@@ -260,7 +261,7 @@ class _ConnPool:
             try:
                 conn.close()
             except Exception:
-                pass
+                metrics.swallowed("kubeclient", "pool close")
 
 
 class _FastSession:
@@ -489,7 +490,7 @@ class KubeAPIServer:
             lambda: datetime.datetime.now(datetime.timezone.utc))
         self._watchers: list[Callable[[str, dict, dict | None], None]] = []
         self._event_seq = 0
-        self._event_lock = threading.Lock()
+        self._event_lock = make_lock("kubeclient.events_seen")
         # informer read cache: the shared indexed ObjectStore
         # (controlplane/cache/store.py); a kind serves reads only once
         # its initial list has synced. ``cache_reads=False`` keeps the
@@ -1022,7 +1023,7 @@ class ShardedKubeAPIServer:
         # kind -> set of shards whose initial list completed (the
         # router cache serves a kind once EVERY shard has listed it)
         self._listed: dict[str, set[str]] = {}
-        self._listed_lock = threading.Lock()
+        self._listed_lock = make_lock("kubeclient.router_listed")
         metrics.SHARD_RING_MEMBERS.labels(
             shard=metrics.shard_label()).set(len(self.ring))
 

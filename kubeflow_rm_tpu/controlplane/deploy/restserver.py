@@ -48,6 +48,7 @@ from kubeflow_rm_tpu.controlplane.apiserver import (
 )
 from kubeflow_rm_tpu.controlplane.deploy.kubeclient import RESOURCES
 from kubeflow_rm_tpu.controlplane import tracing
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 log = logging.getLogger("kubeflow_rm_tpu.restserver")
 
@@ -189,7 +190,7 @@ class RestServer:
         # pre-crash event stream is gone, so a client resuming at a
         # pre-crash rv must relist rather than silently miss the gap.
         self._backlog_floor = int(getattr(api, "_rv", 0) or 0)
-        self._watch_lock = threading.Lock()
+        self._watch_lock = make_lock("restserver.watch_registry")
         api.add_watcher(self._on_event, name="rest")
 
     def _on_event(self, etype: str, obj: dict, old) -> None:
@@ -626,7 +627,7 @@ class RestServer:
                 return sock, addr
 
         S._conns = set()
-        S._conn_lock = threading.Lock()
+        S._conn_lock = make_lock("restserver.conns")
         self._httpd = S(("127.0.0.1", self.port), H)
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()

@@ -146,6 +146,8 @@ class WebhookServer:
                 try:
                     review = json.loads(self.rfile.read(length))
                 except Exception:
+                    log.warning("rejecting undecodable AdmissionReview",
+                                exc_info=True)
                     self._send(400, {"error": "bad AdmissionReview"})
                     return
                 if self.path == "/convert":

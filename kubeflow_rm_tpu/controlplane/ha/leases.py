@@ -28,6 +28,7 @@ import logging
 import threading
 from typing import Callable
 
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 from kubeflow_rm_tpu.controlplane.apiserver import (
     AlreadyExists,
     Conflict,
@@ -93,7 +94,7 @@ class LeaderElector:
         self.release_on_exit = release_on_exit
         self._clock = clock or getattr(api, "clock", None) or (
             lambda: datetime.datetime.now(datetime.timezone.utc))
-        self._lock = threading.Lock()
+        self._lock = make_lock("leases.elector")
         self._leader = False
         self._last_renew: datetime.datetime | None = None
         self.on_started_leading: list[Callable[[], None]] = []

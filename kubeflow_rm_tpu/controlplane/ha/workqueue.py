@@ -34,6 +34,7 @@ import time
 from typing import Callable, Hashable
 
 from kubeflow_rm_tpu.controlplane import metrics
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 
 class ExponentialBackoff:
@@ -90,7 +91,7 @@ class WorkQueue:
         self.max_conflict_retries = max_conflict_retries
         self.max_concurrent = max_concurrent
         self.on_terminal = on_terminal
-        self._lock = threading.Lock()
+        self._lock = make_lock("workqueue")
         self._pending: dict[Hashable, float] = {}  # item -> enqueue time
         self._processing: set[Hashable] = set()
         self._dirty: set[Hashable] = set()

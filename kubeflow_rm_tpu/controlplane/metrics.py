@@ -8,6 +8,8 @@ registry so tests can scrape and reset it hermetically.
 
 from __future__ import annotations
 
+import logging
+
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -393,6 +395,31 @@ SERVING_GENERATED_TOKENS_TOTAL = Counter(
     ["tenant"],
     registry=REGISTRY,
 )
+
+# ---- error accounting: no silent except Exception (KFRM005) ----------
+SWALLOWED_ERRORS_TOTAL = Counter(
+    "swallowed_errors",
+    "Exceptions intentionally absorbed on best-effort paths, by module "
+    "— every `except Exception:` in the tree either re-raises, logs, "
+    "or feeds this counter via metrics.swallowed() (the KFRM005 lint "
+    "rule enforces it). A rising rate on one module is the early-"
+    "warning signal that a 'best effort' path is failing constantly.",
+    ["module"],
+    registry=REGISTRY,
+)
+
+_swallow_log = logging.getLogger("kfrm.swallowed")
+
+
+def swallowed(module: str, context: str = "") -> None:
+    """Account for an intentionally-absorbed exception. Call from
+    inside an ``except`` block: increments
+    ``swallowed_errors_total{module}`` and debug-logs the traceback so
+    the error is countable in production and visible under -v debug."""
+    SWALLOWED_ERRORS_TOTAL.labels(module=module).inc()
+    _swallow_log.debug("swallowed in %s%s", module,
+                       f" ({context})" if context else "", exc_info=True)
+
 
 # the shard identity this process reports under — "" outside sharded
 # deployments so single-process metrics stay label-stable

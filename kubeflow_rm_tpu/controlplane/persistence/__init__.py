@@ -23,6 +23,7 @@ import logging
 import threading
 from dataclasses import dataclass, field
 
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 from kubeflow_rm_tpu.controlplane.persistence import snapshot as snap_mod
 from kubeflow_rm_tpu.controlplane.persistence.wal import (
     WALCorruption,
@@ -63,7 +64,7 @@ class Persistence:
         self._snapshot_every = snapshot_every
         self._since_snapshot = 0
         self._snapshotting = False
-        self._guard = threading.Lock()
+        self._guard = make_lock("persistence.snapshot_guard")
         self.wal = WriteAheadLog(dirpath, fsync=fsync, shard=shard)
 
     # ---- boot --------------------------------------------------------
@@ -102,15 +103,18 @@ class Persistence:
 
     # ---- steady state ------------------------------------------------
     def log(self, *, seq: int, rv: int, verb: str, obj: dict,
-            wait: bool = True) -> None:
-        """Append one write record. With ``wait`` the call returns only
-        once the record is fsync-durable (group commit)."""
-        self.wal.append({"seq": seq, "rv": rv, "verb": verb, "obj": obj},
-                        wait=wait)
+            wait: bool = True) -> int:
+        """Append one write record; return its commit ticket. With
+        ``wait`` the call returns only once the record is fsync-durable
+        (group commit); without it, the caller must later ``flush``
+        up to the returned ticket before acking the write."""
+        ticket = self.wal.append(
+            {"seq": seq, "rv": rv, "verb": verb, "obj": obj}, wait=wait)
         self._since_snapshot += 1
+        return ticket
 
-    def flush(self) -> None:
-        self.wal.flush()
+    def flush(self, upto: int | None = None) -> None:
+        self.wal.flush(upto=upto)
 
     def snapshot_due(self) -> bool:
         return self._since_snapshot >= self._snapshot_every \

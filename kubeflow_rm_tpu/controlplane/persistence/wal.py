@@ -31,6 +31,7 @@ import zlib
 from typing import Iterator
 
 from kubeflow_rm_tpu.controlplane import metrics
+from kubeflow_rm_tpu.analysis.lockgraph import make_condition, make_lock
 
 _FRAME = struct.Struct("<II")  # payload length, crc32(payload)
 
@@ -102,7 +103,7 @@ class WriteAheadLog:
         os.makedirs(dirpath, exist_ok=True)
         self.dir = dirpath
         self._fsync = fsync
-        self._cv = threading.Condition(threading.Lock())
+        self._cv = make_condition("wal.cv", lock=make_lock("wal.cv"))
         self._pending: list[bytes] = []
         self._submitted = 0   # frames accepted
         self._durable = 0     # frames flushed (+fsynced)
@@ -180,25 +181,39 @@ class WriteAheadLog:
         """Flush + fsync the open segment, then start a new one. The
         snapshot path calls this under the apiserver's write lock so
         every record at-or-below the snapshot's seq horizon lives in a
-        now-closed segment (making compaction a plain unlink)."""
+        now-closed segment (making compaction a plain unlink).
+
+        The write+fsync+reopen run OUTSIDE the condvar, made exclusive
+        by the same ``_flushing`` flag group commit uses — appends keep
+        buffering during the fsync (they only touch ``_pending``), and
+        anything buffered while we rotate simply lands in the new
+        segment on its own flush."""
         with self._cv:
             while self._flushing:  # let an in-flight group commit land
                 self._cv.wait(0.5)
             batch = b"".join(self._pending)
             self._pending.clear()
             target = self._submitted
+            self._flushing = True
+        ok = False
+        try:
             if batch:
                 self._f.write(batch)
             self._f.flush()
             if self._fsync:
                 os.fsync(self._f.fileno())
-            self._durable = max(self._durable, target)
-            if batch:
-                self._m_bytes.inc(len(batch))
             self._f.close()
             self._seg_index += 1
             self._f = open(self._segment_path(self._seg_index), "ab")
-            self._cv.notify_all()
+            ok = True
+        finally:
+            with self._cv:
+                if ok:
+                    self._durable = max(self._durable, target)
+                    if batch:
+                        self._m_bytes.inc(len(batch))
+                self._flushing = False
+                self._cv.notify_all()
 
     def compact(self, keep_from_index: int | None = None) -> int:
         """Unlink closed segments older than the open one (or than

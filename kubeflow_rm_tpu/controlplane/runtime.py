@@ -27,6 +27,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
 )
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer, Conflict, NotFound
 from kubeflow_rm_tpu.controlplane import tracing
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 
 @dataclass(frozen=True, order=True)
@@ -107,7 +108,7 @@ class Manager:
         # terminal path, per-controller concurrency caps
         self._queues: dict[str, "WorkQueue"] = {}
         # guards the errors list; each queue carries its own lock
-        self._queue_lock = threading.Lock()
+        self._queue_lock = make_lock("runtime.queue")
         self.errors: list[tuple[str, Request, Exception]] = []
         # trace context riding the workqueue: items are deduped frozen
         # dataclasses, so causality travels in this side map keyed by
@@ -475,8 +476,7 @@ def serial_writes() -> bool:
 def _shared_child_pool():
     global _child_pool, _child_pool_lock
     if _child_pool_lock is None:
-        import threading
-        _child_pool_lock = threading.Lock()
+        _child_pool_lock = make_lock("runtime.child_pool")
     with _child_pool_lock:
         if _child_pool is None:
             from concurrent.futures import ThreadPoolExecutor

@@ -50,6 +50,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     parse_quantity,
 )
 from kubeflow_rm_tpu.controlplane.api.tpu import GOOGLE_TPU_RESOURCE
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 #: phases whose pods no longer occupy their node's chips (a kubelet
 #: frees the device plugin allocation when the pod reaches a terminal
@@ -109,7 +110,9 @@ class _Node:
         self.used = 0.0                 # chips
         self.cpu_capacity = cpu_capacity
         self.cpu_used = 0.0
-        self.lock = threading.Lock()
+        # one ranked family: _commit acquires gang members sorted by
+        # node name, which is exactly the rank the analyser verifies
+        self.lock = make_lock("scheduler.node", rank=name)
 
 
 class _Entry:
@@ -126,16 +129,19 @@ class SchedulerCache:
     """Informer-fed per-node chip accounting with assume/bind.
 
     Lock order (held-simultaneously pairs only): ``_relist_lock`` →
-    node locks (sorted by name) → ``_plock``. The event path takes
-    ``_plock`` and node locks sequentially, never nested.
+    ``_nlock`` → node locks (sorted by name) → ``_plock``. The event
+    path takes ``_plock`` and node locks sequentially, never nested.
+    The canonical cross-module hierarchy lives in
+    :mod:`kubeflow_rm_tpu.analysis.hierarchy`; the lockgraph storm arm
+    verifies the measured acquisition graph embeds into it.
     """
 
     def __init__(self, backend=None):
         self._nodes: dict[str, _Node] = {}
         self._pods: dict[tuple[str | None, str], _Entry] = {}
-        self._plock = threading.Lock()       # the pod→entry map
-        self._nlock = threading.Lock()       # node-map membership
-        self._relist_lock = threading.Lock()  # rebuild vs bind-commit
+        self._plock = make_lock("scheduler.pods_map")
+        self._nlock = make_lock("scheduler.nodes_map")
+        self._relist_lock = make_lock("scheduler.relist")
         self._stale = True                   # prime on first use
         self._assumed = 0
         self._backend = (weakref.ref(backend)
@@ -558,7 +564,7 @@ class SchedulerCache:
 # ---- per-backend cache registry + the legacy A/B switch --------------
 
 _caches: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-_caches_lock = threading.Lock()
+_caches_lock = make_lock("scheduler.registry")
 
 _legacy_scan = False
 

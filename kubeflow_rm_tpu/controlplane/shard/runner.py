@@ -23,6 +23,7 @@ import time
 import urllib.request
 
 from kubeflow_rm_tpu.controlplane.shard.worker import shard_worker_main
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 log = logging.getLogger("kubeflow_rm_tpu.shard.runner")
 
@@ -44,7 +45,7 @@ class ShardRunner:
         self._procs: dict[str, multiprocessing.process.BaseProcess] = {}
         self._cfgs: dict[str, dict] = {}
         self._stopping = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("shard.watchdog")
         self._supervise = supervise
         for i in range(n_shards):
             name = f"shard-{i}"

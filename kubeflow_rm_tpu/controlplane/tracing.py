@@ -40,6 +40,8 @@ import threading
 import time
 from collections import deque
 
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
+
 #: annotation key carrying a serialized context across async hops
 TRACE_ANNOTATION = "tpu.kubeflow.org/trace"
 #: HTTP header (W3C trace-context). Version 00, sampled flag 01.
@@ -170,7 +172,7 @@ class SpanCollector:
 
     def __init__(self, capacity: int = 8192, *,
                  slow_threshold_s: float = 0.25, slow_keep: int = 32):
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracing.collector")
         self._ring: deque[Span] = deque(maxlen=capacity)
         self.slow_threshold_s = slow_threshold_s
         self.slow_keep = slow_keep

@@ -118,6 +118,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         """This process's collector merged with every shard's
         ``/debug/traces`` export (a sharded api hops cross-process, so
         one trace's spans are scattered over the shard collectors)."""
+        from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
         from kubeflow_rm_tpu.controlplane import tracing
         local = tracing.collector()
         span_lists = [local.spans()]
@@ -133,6 +134,8 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
                             timeout=2.0) as resp:
                         payload = _json.loads(resp.read().decode())
                 except Exception:  # noqa: BLE001 - shard may be down
+                    cp_metrics.swallowed("dashboard",
+                                         "shard trace fetch")
                     continue
                 span_lists.append(payload.get("spans") or [])
                 slow.extend(payload.get("slow") or [])

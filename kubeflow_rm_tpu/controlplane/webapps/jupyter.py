@@ -37,6 +37,7 @@ from kubeflow_rm_tpu.controlplane import suspend as suspend_mod
 from kubeflow_rm_tpu.controlplane.webapps import status as status_mod
 from kubeflow_rm_tpu.controlplane.webapps.core import WebApp, json_body
 from kubeflow_rm_tpu.controlplane.webapps.readiness import ReadinessHub
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 DEFAULT_CONFIG = __file__.rsplit("/", 1)[0] + "/spawner_ui_config.yaml"
 
@@ -296,7 +297,7 @@ def create_app(api: APIServer, *, config_path: str | None = None,
     # readiness hub is built lazily: the in-memory backend spawns a
     # dispatch thread per watcher, and most app instances (tests,
     # short-lived tools) never take a readiness long-poll
-    _hub_lock = threading.Lock()
+    _hub_lock = make_lock("jupyter.hub_registry")
     _hub_box: list[ReadinessHub] = []
 
     def _hub() -> ReadinessHub:

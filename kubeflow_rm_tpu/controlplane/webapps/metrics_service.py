@@ -30,6 +30,8 @@ from typing import Protocol
 
 from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api
 from kubeflow_rm_tpu.controlplane.api.meta import deep_get, parse_quantity
+from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 
 class MetricsService(Protocol):
@@ -53,6 +55,7 @@ def _controlplane_section(api=None) -> dict:
             )
             lease = api.try_get("Lease", DEFAULT_LEASE_NAME, "kubeflow")
         except Exception:  # noqa: BLE001 - lease kind may not exist
+            cp_metrics.swallowed("metrics_service", "lease read")
             lease = None
         if lease:
             spec = lease.get("spec") or {}
@@ -67,6 +70,7 @@ def _controlplane_section(api=None) -> dict:
         try:
             cache_stats = store.stats()
         except Exception:  # noqa: BLE001 - pills are best-effort
+            cp_metrics.swallowed("metrics_service", "cache stats")
             cache_stats = None
     return {
         "leader": leader,
@@ -503,10 +507,10 @@ class MetricsHistory:
         self.interval_s = interval_s
         self._ring: collections.deque = collections.deque(
             maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics_service.sampler")
         self._stop = threading.Event()
         self._thread_started = False
-        self._thread_lock = threading.Lock()
+        self._thread_lock = make_lock("metrics_service.sampler_thread")
         # seed one point synchronously so a just-booted dashboard has
         # a current sample; the polling thread starts LAZILY on the
         # first history read, so apps that never chart never pay for
@@ -514,7 +518,7 @@ class MetricsHistory:
         try:
             self.sample()
         except Exception:  # noqa: BLE001 - charts are best-effort
-            pass
+            cp_metrics.swallowed("metrics_service", "seed sample")
 
     def _ensure_thread(self):
         if self.interval_s <= 0 or self._thread_started:
@@ -530,7 +534,7 @@ class MetricsHistory:
             try:
                 self.sample()
             except Exception:  # noqa: BLE001 - keep sampling
-                pass
+                cp_metrics.swallowed("metrics_service", "sampler tick")
 
     def stop(self):
         self._stop.set()

@@ -31,6 +31,7 @@ import time
 from typing import Callable
 
 from kubeflow_rm_tpu.controlplane import metrics, tracing
+from kubeflow_rm_tpu.analysis.lockgraph import make_condition, make_lock
 
 # a wedged watch degrades to this guard tick instead of hanging waiters
 _GUARD_TICK_S = 1.0
@@ -44,7 +45,7 @@ class _KeyState:
     __slots__ = ("cond", "seq", "event_t", "waiters")
 
     def __init__(self) -> None:
-        self.cond = threading.Condition()
+        self.cond = make_condition("readiness.key")
         self.seq = 0
         self.event_t: float | None = None
         self.waiters = 0
@@ -54,7 +55,7 @@ class ReadinessHub:
     """Fan-in point between the watch stream and readiness long-polls."""
 
     def __init__(self, api) -> None:
-        self._lock = threading.Lock()          # the key registry
+        self._lock = make_lock("readiness.registry")  # key registry
         self._keys: dict[tuple[str, str], _KeyState] = {}
         backend = getattr(api, "api", api)
         backend.add_watcher(self._on_event, name="readiness-hub")

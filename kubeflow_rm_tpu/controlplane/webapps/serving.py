@@ -49,6 +49,7 @@ from dataclasses import dataclass
 from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
 from kubeflow_rm_tpu.controlplane import tracing
 from kubeflow_rm_tpu.controlplane.deploy.kubeclient import TokenBucket
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 
 @dataclass(frozen=True)
@@ -102,7 +103,7 @@ class ServingGateway:
         self.max_queue = max_queue
         self.admission = admission
         self._clock = clock or time.monotonic
-        self._lock = threading.Lock()        # engine + pending state
+        self._lock = make_lock("serving.gateway")  # engine + pending
         self._rate_buckets: dict[str, TokenBucket] = {}
         self._token_buckets: dict[str, TokenBucket] = {}
         self._pending: list[_Pending] = []

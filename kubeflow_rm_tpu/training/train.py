@@ -14,6 +14,8 @@ framework's job is the shardings.
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -121,7 +123,13 @@ def state_shardings(cfg: TrainConfig, state: TrainState, mesh: Mesh) -> TrainSta
                 return jax.tree_util.tree_unflatten(param_treedef,
                                                     shard_leaves)
         except Exception:
-            pass
+            # fall through to the structural recursion below — but
+            # leave a trace, since a silently-unsharded optimizer
+            # state is exactly the kind of fault that only shows up
+            # as an OOM three steps later
+            logging.getLogger("kubeflow_rm_tpu.training").debug(
+                "state sharding fast path failed; recursing node "
+                "structurally", exc_info=True)
         if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
             return type(node)(*(map_node(c) for c in node))
         if isinstance(node, (list, tuple)):

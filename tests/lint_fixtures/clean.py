@@ -1,0 +1,35 @@
+"""Fixture: idiomatic concurrency code — every rule should pass."""
+import logging
+import time
+
+from kubeflow_rm_tpu.analysis.lockgraph import make_condition, make_lock
+
+log = logging.getLogger(__name__)
+
+
+class Clean:
+    def __init__(self):
+        self._lock = make_lock("fixture.clean")
+        self._cv = make_condition("fixture.clean.cv")
+        self._items = []
+
+    def push(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def throttle(self):
+        time.sleep(0.01)
+
+    def drain(self):
+        self._lock.acquire()
+        try:
+            items, self._items = self._items, []
+        finally:
+            self._lock.release()
+        return items
+
+    def careful(self):
+        try:
+            self.drain()
+        except Exception:
+            log.warning("drain failed", exc_info=True)

@@ -1,0 +1,12 @@
+"""Fixture: the escape hatches — inline and file-wide disables."""
+# kfrm: disable-file=KFRM001
+import threading
+import time
+
+raw = threading.Lock()  # silenced by the file-wide KFRM001 disable
+
+
+def pinned():
+    with raw:
+        # measured: the sleep IS the point of this code path
+        time.sleep(0.1)  # kfrm: disable=KFRM002

@@ -1,0 +1,11 @@
+"""Fixture: raw threading primitives outside the analysis factory."""
+import threading
+from threading import RLock
+
+guard = threading.Lock()        # KFRM001
+other = RLock()                 # KFRM001
+
+
+class Thing:
+    def __init__(self):
+        self.cv = threading.Condition()   # KFRM001

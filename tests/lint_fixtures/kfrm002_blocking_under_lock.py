@@ -1,0 +1,22 @@
+"""Fixture: blocking calls lexically inside a ``with <lock>:`` block."""
+import os
+import time
+
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
+
+
+class Store:
+    def __init__(self):
+        self._lock = make_lock("fixture.store")
+        self._fd = os.open("/dev/null", os.O_WRONLY)
+
+    def slow_write(self):
+        with self._lock:
+            time.sleep(0.5)          # KFRM002
+            os.fsync(self._fd)       # KFRM002
+
+    def fine(self):
+        with self._lock:
+            x = 1
+        time.sleep(0.0)  # outside the lock: clean
+        return x

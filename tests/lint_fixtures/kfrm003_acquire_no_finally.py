@@ -1,0 +1,23 @@
+"""Fixture: manual ``.acquire()`` without a try/finally release."""
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
+
+
+class Leaky:
+    def __init__(self):
+        self._lock = make_lock("fixture.leaky")
+
+    def bad(self):
+        self._lock.acquire()        # KFRM003: an exception leaks the lock
+        do_work()
+        self._lock.release()
+
+    def good(self):
+        self._lock.acquire()
+        try:
+            do_work()
+        finally:
+            self._lock.release()
+
+
+def do_work():
+    pass

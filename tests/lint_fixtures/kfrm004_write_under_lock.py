@@ -1,0 +1,19 @@
+"""Fixture: apiserver/kubeclient write verbs called while holding a lock."""
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
+
+
+class Controller:
+    def __init__(self, api):
+        self.api = api
+        self._lock = make_lock("fixture.controller")
+        self._state = {}
+
+    def reconcile(self, obj):
+        with self._lock:
+            self._state[obj["metadata"]["name"]] = obj
+            self.api.update_status(obj)     # KFRM004
+
+    def fine(self, obj):
+        with self._lock:
+            self._state[obj["metadata"]["name"]] = obj
+        self.api.update_status(obj)  # outside the lock: clean

@@ -1,0 +1,30 @@
+"""Fixture: broad except that neither logs, counts, re-raises, nor
+inspects the bound exception."""
+
+
+def fragile():
+    try:
+        risky()
+    except Exception:       # KFRM005
+        pass
+
+
+def handled():
+    import logging
+    try:
+        risky()
+    except Exception:
+        logging.getLogger(__name__).warning("risky failed", exc_info=True)
+
+
+def recorded():
+    errors = []
+    try:
+        risky()
+    except Exception as e:
+        errors.append(e)
+    return errors
+
+
+def risky():
+    raise RuntimeError("boom")

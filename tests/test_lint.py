@@ -1,0 +1,104 @@
+"""The KFRM static lint: every rule catches its seeded fixture
+violation, the escape hatches work, and the shipped tree is clean
+(the same invariant the CI gate enforces)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubeflow_rm_tpu.analysis.lint import (
+    ALL_RULES,
+    lint_paths,
+    lint_source,
+)
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+REPO = Path(__file__).parent.parent
+
+
+def _lint_fixture(name: str):
+    path = FIXTURES / name
+    return lint_source(path.read_text(), str(path))
+
+
+# (fixture, rule, expected violation lines)
+SEEDED = [
+    ("kfrm001_raw_lock.py", "KFRM001", {5, 6, 11}),
+    ("kfrm002_blocking_under_lock.py", "KFRM002", {15, 16}),
+    ("kfrm003_acquire_no_finally.py", "KFRM003", {10}),
+    ("kfrm004_write_under_lock.py", "KFRM004", {14}),
+    ("kfrm005_silent_swallow.py", "KFRM005", {8}),
+]
+
+
+@pytest.mark.parametrize("fixture,rule,lines",
+                         SEEDED, ids=[s[1] for s in SEEDED])
+def test_seeded_violation_detected(fixture, rule, lines):
+    findings = _lint_fixture(fixture)
+    assert {f.rule for f in findings} == {rule}, findings
+    assert {f.line for f in findings} == lines, findings
+
+
+def test_clean_fixture_has_no_findings():
+    assert _lint_fixture("clean.py") == []
+
+
+def test_inline_and_file_wide_disables():
+    # raw lock silenced file-wide, sleep-under-lock silenced inline
+    assert _lint_fixture("disabled.py") == []
+
+
+def test_syntax_error_reports_kfrm000():
+    findings = lint_source("def broken(:\n", "bad.py")
+    assert [f.rule for f in findings] == ["KFRM000"]
+
+
+def test_lockgraph_factory_is_allowlisted_for_kfrm001():
+    path = REPO / "kubeflow_rm_tpu" / "analysis" / "lockgraph.py"
+    findings = lint_paths([str(path)])
+    assert not any(f.rule == "KFRM001" for f in findings), findings
+
+
+def test_shipped_tree_is_clean():
+    """The invariant the CI lint gate enforces: zero findings over the
+    package and the conformance harness."""
+    findings = lint_paths([str(REPO / "kubeflow_rm_tpu"),
+                           str(REPO / "conformance")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_rule_ids_are_unique_and_documented():
+    ids = [cls.rule_id for cls in ALL_RULES]
+    assert len(ids) == len(set(ids))
+    assert ids == sorted(ids)
+    for cls in ALL_RULES:
+        assert cls.__doc__, f"{cls.rule_id} has no docstring"
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "kubeflow_rm_tpu.analysis.lint", *args],
+        capture_output=True, text=True, cwd=str(REPO))
+
+
+def test_cli_exit_one_on_findings_and_json_output():
+    proc = _run_cli("--json", str(FIXTURES / "kfrm001_raw_lock.py"))
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert all(f["rule"] == "KFRM001" for f in payload)
+    assert {"rule", "path", "line", "col", "message"} <= set(payload[0])
+
+
+def test_cli_exit_zero_on_clean_file():
+    proc = _run_cli(str(FIXTURES / "clean.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_rule_filter():
+    # restricting to KFRM005 makes the KFRM001 fixture pass
+    proc = _run_cli("--rules", "KFRM005",
+                    str(FIXTURES / "kfrm001_raw_lock.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -561,6 +561,22 @@ def main() -> int:
                              args.flood_reqs, args.slots, args.slot_len,
                              args.slo_ms, args.qps, args.burst,
                              overrides)
+    # shared artifact header: ratchet.py refuses to diff storms whose
+    # arm flags (campaign/preset/load shape) disagree
+    import os
+
+    from kubeflow_rm_tpu.controlplane.obs.runmeta import build_run_meta
+    interleave = os.environ.get("KFRM_RUN_INTERLEAVE")
+    out["run_meta"] = build_run_meta(
+        "serve_bench",
+        {
+            "campaign": args.campaign, "preset": args.preset,
+            "quant": args.quant, "tenants": args.tenants,
+            "reqs_per_tenant": args.reqs_per_tenant,
+            "flood_threads": args.flood_threads, "slots": args.slots,
+            "slo_ms": args.slo_ms, "qps": args.qps,
+        },
+        interleave_index=int(interleave) if interleave else None)
     print(json.dumps(out))
     if args.out:
         with open(args.out, "w") as f:

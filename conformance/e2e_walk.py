@@ -99,7 +99,7 @@ class Walk:
     def __init__(self, api, *, has_fake_kubelet: bool,
                  fast_culling: bool, rest_url: str | None = None,
                  image: str = "jupyter-jax:latest", ha=None,
-                 only: set | None = None):
+                 only: set | None = None, flight_out: str = ""):
         self.api = api
         self.has_fake_kubelet = has_fake_kubelet
         self.fast_culling = fast_culling
@@ -107,6 +107,7 @@ class Walk:
         self.image = image
         self.ha = ha
         self.only = only
+        self.flight_out = flight_out
         self.results: list[dict] = []
         self.hosts = tpu_api.lookup(ACCEL).hosts
 
@@ -559,6 +560,7 @@ class Walk:
         from collections import Counter
         from concurrent.futures import ThreadPoolExecutor
 
+        from kubeflow_rm_tpu.controlplane import obs, tracing
         from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
             make_tpu_node,
         )
@@ -569,10 +571,26 @@ class Walk:
 
         n_shards, n_notebooks = 4, 12
         base = tempfile.mkdtemp(prefix="e2e-shards-")
-        runner = ShardRunner(n_shards, base_dir=base, manager_workers=4)
+        runner = ShardRunner(n_shards, base_dir=base, manager_workers=4,
+                             tracing=tracing.enabled())
+        # the black box: TSDB federating every shard's /metrics, the
+        # SLO engine (shard-deaths pages critical), and the flight
+        # recorder — armed on the watchdog's death hook AND on any
+        # alert transition to critical
+        observer = obs.Observer(
+            interval_s=0.5, shard_urls=runner.urls,
+            liveness=runner.liveness,
+            run_meta=obs.build_run_meta(
+                "e2e_walk", {"scenario": "shard_chaos",
+                             "shards": n_shards,
+                             "notebooks": n_notebooks,
+                             "tracing": tracing.enabled()}))
+        runner.set_on_death(observer.on_shard_death)
         stop = threading.Event()
         try:
             runner.start(timeout=120)
+            observer.tick()      # baseline sample before the storm
+            observer.start()
             router = ShardedKubeAPIServer(
                 runner.urls, identity="e2e-chaos", retry_window_s=30.0)
             events: list[tuple] = []
@@ -616,11 +634,24 @@ class Walk:
                 if i == n_notebooks // 2:
                     killed["pid"] = runner.kill(victim)
                     killed["t"] = time.monotonic()
-                router.create(make_notebook(
-                    f"chaos-{i}", ns_of[i], accelerator_type=ACCEL,
-                    image=self.image,
-                    annotations={
-                        nb_api.CULLING_EXCLUDE_ANNOTATION: "true"}))
+                # one root trace per provision (create -> full slice
+                # readiness): spawns that straddle the outage come out
+                # slow, land in the collector's tail sample, and give
+                # the flight bundle its critical paths
+                with tracing.start_span(f"provision chaos-{i}",
+                                        kind="client", root=True):
+                    router.create(make_notebook(
+                        f"chaos-{i}", ns_of[i], accelerator_type=ACCEL,
+                        image=self.image,
+                        annotations={
+                            nb_api.CULLING_EXCLUDE_ANNOTATION: "true"}))
+                    self.wait(
+                        lambda: (lambda nb: nb and (
+                            nb.get("status") or {}).get(
+                            "readyReplicas") == self.hosts)(
+                            router.try_get("Notebook", f"chaos-{i}",
+                                           ns_of[i])),
+                        timeout=120, what=f"chaos-{i} ready in-span")
 
             with ThreadPoolExecutor(max_workers=4) as pool:
                 list(pool.map(spawn, range(n_notebooks)))
@@ -661,14 +692,33 @@ class Walk:
             on_victim = sum(1 for ns in ns_of
                             if router.shard_of("Notebook", None, ns)
                             == victim)
-            return {"shards": n_shards, "notebooks": n_notebooks,
-                    "killed_shard": victim,
-                    "killed_pid": killed["pid"],
-                    "notebooks_on_killed_shard": on_victim,
-                    "respawn_ms": respawn_ms,
-                    "lost_notebooks": 0,
-                    "watch_recovered": True}
+            detail = {"shards": n_shards, "notebooks": n_notebooks,
+                      "killed_shard": victim,
+                      "killed_pid": killed["pid"],
+                      "notebooks_on_killed_shard": on_victim,
+                      "respawn_ms": respawn_ms,
+                      "lost_notebooks": 0,
+                      "watch_recovered": True}
+            # explicit chaos-scenario trigger: freeze the post-recovery
+            # state (trailing metric window, slow traces + critical
+            # paths, the shard-deaths alert, liveness, lockgraph) into
+            # one bundle while the shards are still up to scrape
+            observer.tick()
+            bundle = observer.flight.trigger("shard_chaos_complete",
+                                             detail=detail)
+            detail["flight"] = {
+                "slow_traces": len(bundle["slow_traces"]),
+                "metric_series": len(bundle.get("metrics") or []),
+                "active_alerts": [a["slo"] for a in
+                                  bundle["alerts"]["active"]],
+                "bundles": observer.flight.triggered_total,
+            }
+            if self.flight_out:
+                observer.flight.dump_json(self.flight_out, bundle)
+                detail["flight"]["path"] = self.flight_out
+            return detail
         finally:
+            observer.stop()
             stop.set()
             runner.stop()
             shutil.rmtree(base, ignore_errors=True)
@@ -922,6 +972,11 @@ def main() -> int:
     ap.add_argument("--trace-out", default="",
                     help="write per-scenario traces + critical paths "
                          "to this JSON file (with --tracing)")
+    ap.add_argument("--flight-out", default="",
+                    help="shard_chaos: write the flight-recorder "
+                         "bundle (trailing metric window, slow traces "
+                         "+ critical paths, alerts, shard liveness) "
+                         "to this JSON file")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
 
@@ -940,7 +995,7 @@ def main() -> int:
         walk = Walk(api, has_fake_kubelet=True, fast_culling=True,
                     rest_url=rest.url,
                     image=args.image or "jupyter-jax:latest",
-                    ha=ha, only=only)
+                    ha=ha, only=only, flight_out=args.flight_out)
     else:
         from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
             KubeAPIServer,
@@ -957,7 +1012,17 @@ def main() -> int:
         m["stop"].set()
     ran = [r for r in results if r.get("ok") is not None]
     passed = [r for r in ran if r["ok"]]
+    import os
+
+    from kubeflow_rm_tpu.controlplane.obs.runmeta import build_run_meta
+    interleave = os.environ.get("KFRM_RUN_INTERLEAVE")
     artifact = {
+        "run_meta": build_run_meta(
+            "e2e_walk",
+            {"backend": args.backend,
+             "scenarios": args.scenarios or "all",
+             "tracing": bool(args.tracing)},
+            interleave_index=int(interleave) if interleave else None),
         "backend": args.backend,
         "scenarios": results,
         "passed": len(passed),

@@ -49,6 +49,32 @@ from kubeflow_rm_tpu.controlplane.webapps import jupyter as jwa  # noqa: E402
 USER = "conformance@corp.com"
 
 
+def _run_meta(args, mode: str) -> dict:
+    """The shared artifact header ``benchmarks/ratchet.py`` keys on:
+    two artifacts are only comparable when these arm flags agree."""
+    import os
+
+    from kubeflow_rm_tpu.controlplane.obs.runmeta import build_run_meta
+    interleave = os.environ.get("KFRM_RUN_INTERLEAVE")
+    return build_run_meta(
+        "spawn_conformance",
+        {
+            "mode": mode,
+            "shards": args.shards,
+            "wal": args.shards > 1 and not args.no_wal,
+            "cache": "off" if args.no_cache else "on",
+            "lock": "global" if args.global_lock else "sharded",
+            "writes": "serial" if args.serial_writes else "batched",
+            "schedule": "legacy" if args.legacy_schedule else "cache",
+            "oversubscribe": not args.no_oversubscribe,
+            "readiness": "poll" if args.poll_readiness else "push",
+            "tracing": not args.no_tracing,
+            "notebooks": args.notebooks,
+            "concurrency": max(1, args.concurrency),
+        },
+        interleave_index=int(interleave) if interleave else None)
+
+
 def wallclock_main(args) -> int:
     """Full process layout over sockets; wall-time p50 across
     ``--runs`` independent boots, with a per-phase breakdown computed
@@ -89,6 +115,7 @@ def wallclock_main(args) -> int:
     p50s = sorted(r["provision_p50_ms"] for r in runs)
     p95s = sorted(r["provision_p95_ms"] for r in runs)
     result = {
+        "run_meta": _run_meta(args, "wallclock"),
         "mode": "wallclock",
         "shards": args.shards,
         "wal": args.shards > 1 and not args.no_wal,
@@ -136,6 +163,7 @@ def wallclock_main(args) -> int:
         }
         if args.trace_out:
             artifact = {
+                "run_meta": result["run_meta"],
                 "mode": "wallclock",
                 "shards": args.shards,
                 "notebooks": args.notebooks,
@@ -1081,6 +1109,7 @@ def main() -> int:
 
     p50 = sorted(t for t, _ in latencies)[len(latencies) // 2]
     result = {
+        "run_meta": _run_meta(args, "in-process"),
         "notebooks": args.notebooks,
         "slice": accel,
         "hosts_per_slice": topo.hosts,

@@ -73,6 +73,14 @@ LOCK_HIERARCHY: dict[str, int] = {
     "serving.gateway": 440,
     "metrics_service.sampler_thread": 450,  # lazy sampler-thread start
     "metrics_service.sampler": 460,         # the history ring
+    # obs locks never nest with each other by design (burn rates are
+    # computed before the engine lock; flight bundles are assembled
+    # lock-free and only appended under obs.flight), but they sit
+    # below tracing.collector so a capture reading the span ring while
+    # holding one would still be uphill
+    "obs.engine": 470,              # SLO alert state machine
+    "obs.tsdb": 480,                # ring-buffer TSDB series map
+    "obs.flight": 490,              # flight-recorder bundle ring
     "tracing.collector": 510,
     # -- persistence, innermost ----------------------------------------
     "persistence.snapshot_guard": 610,

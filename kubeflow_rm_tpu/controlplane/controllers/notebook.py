@@ -290,9 +290,39 @@ class NotebookController(Controller):
                                   default=[]) or []
             ]
         if deep_get(notebook, "status") != status:
+            prev_ready = deep_get(notebook, "status", "readyReplicas",
+                                  default=0)
             notebook["status"] = status
             api.update_status(notebook)
+            if not parked and hosts > 0 and prev_ready < hosts <= ready:
+                self._observe_provision_latency(api, notebook)
         metrics.NOTEBOOK_RUNNING.set(self._count_running(api))
+
+    @staticmethod
+    def _observe_provision_latency(api: APIServer, notebook: dict
+                                   ) -> None:
+        """First transition to fully-ready: record creationTimestamp ->
+        now as the provision SLI (``provision_latency_seconds``). Uses
+        the apiserver's clock so injected test clocks stay coherent."""
+        import datetime
+        try:
+            created = deep_get(notebook, "metadata", "creationTimestamp")
+            if not created:
+                return
+            clock = getattr(api, "clock", None)
+            now = clock() if callable(clock) \
+                else datetime.datetime.now(datetime.timezone.utc)
+            then = datetime.datetime.fromisoformat(
+                str(created).replace("Z", "+00:00"))
+            if then.tzinfo is None and now.tzinfo is not None:
+                then = then.replace(tzinfo=now.tzinfo)
+            if now.tzinfo is None and then.tzinfo is not None:
+                then = then.replace(tzinfo=None)
+            elapsed = (now - then).total_seconds()
+            if elapsed >= 0:
+                metrics.PROVISION_LATENCY_SECONDS.observe(elapsed)
+        except Exception:  # noqa: BLE001 - SLI capture is best-effort
+            metrics.swallowed("notebook", "provision latency observe")
 
     def _count_running(self, api: APIServer) -> int:
         # scan(): read-only references — this gauge refresh runs at the

@@ -396,6 +396,26 @@ SERVING_GENERATED_TOKENS_TOTAL = Counter(
     registry=REGISTRY,
 )
 
+# ---- observability loop: provision SLI + watchdog-visible deaths -----
+PROVISION_LATENCY_SECONDS = Histogram(
+    "provision_latency_seconds",
+    "Notebook provision latency observed in-platform: CR "
+    "creationTimestamp to the status mirror first seeing readyReplicas "
+    "reach desired — the SLI behind the provision-p50 SLO (the "
+    "conformance harness measures the same edge from the client side)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             120.0),
+    registry=REGISTRY,
+)
+SHARD_DEATHS_TOTAL = Counter(
+    "shard_deaths",
+    "Shard worker processes the ShardRunner watchdog observed dead and "
+    "respawned, by shard — feeds the shard-deaths critical SLO so a "
+    "respawn is an *alert*, not just a log line",
+    ["shard"],
+    registry=REGISTRY,
+)
+
 # ---- error accounting: no silent except Exception (KFRM005) ----------
 SWALLOWED_ERRORS_TOTAL = Counter(
     "swallowed_errors",

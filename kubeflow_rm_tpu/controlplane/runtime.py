@@ -36,6 +36,24 @@ class Request:
     name: str
 
 
+def _chaos_reconcile_sleep(controller: str) -> None:
+    """Fault injection for the perf-ratchet red-run demo:
+    ``KFRM_CHAOS_RECONCILE_SLEEP_MS=<ms>`` stalls every reconcile (or
+    only ``KFRM_CHAOS_RECONCILE_CONTROLLER=<name>``'s) by that long,
+    inside the reconcile span so the injected latency lands on the
+    trace's critical path exactly where a real slow hop would. Off
+    unless the env var is set; never enabled in production paths."""
+    import os
+    ms = os.environ.get("KFRM_CHAOS_RECONCILE_SLEEP_MS")
+    if not ms:
+        return
+    only = os.environ.get("KFRM_CHAOS_RECONCILE_CONTROLLER", "")
+    if only and only != controller:
+        return
+    import time
+    time.sleep(float(ms) / 1000.0)
+
+
 class Controller:
     """Subclass contract: set ``kind``, implement ``reconcile``."""
 
@@ -225,6 +243,7 @@ class Manager:
                 q = self._queues[c.name]
                 try:
                     with self._reconcile_span(c, req):
+                        _chaos_reconcile_sleep(c.name)
                         requeue_after = c.reconcile(self.api, req)
                     q.forget(req)
                     if requeue_after is not None:
@@ -372,6 +391,7 @@ class Manager:
         try:
             try:
                 with self._reconcile_span(c, req):
+                    _chaos_reconcile_sleep(c.name)
                     requeue_after = c.reconcile(self.api, req)
                 q.forget(req)
                 if requeue_after is not None:

@@ -38,7 +38,8 @@ class ShardRunner:
     def __init__(self, n_shards: int, *, base_dir: str | None = None,
                  wal: bool = True, manager_workers: int = 8,
                  auto_ready: bool = True, hang_dump_s: float = 0.0,
-                 supervise: bool = True, tracing: bool = False):
+                 supervise: bool = True, tracing: bool = False,
+                 on_death=None):
         if n_shards < 1:
             raise ValueError("need at least one shard")
         self._ctx = multiprocessing.get_context("spawn")
@@ -47,6 +48,11 @@ class ShardRunner:
         self._stopping = False
         self._lock = make_lock("shard.watchdog")
         self._supervise = supervise
+        # flight-recorder hook: ``on_death(name, exitcode)`` fires from
+        # the watchdog thread AFTER the respawn is issued, so the
+        # callback (which may scrape /metrics, dump bundles, ...) never
+        # delays recovery
+        self._on_death = on_death
         for i in range(n_shards):
             name = f"shard-{i}"
             wal_dir = None
@@ -76,9 +82,24 @@ class ShardRunner:
     def wal_dir(self, name: str) -> str | None:
         return self._cfgs[name]["wal_dir"]
 
+    def liveness(self) -> dict[str, bool]:
+        """Per-shard aliveness as the supervisor sees it — the flight
+        recorder's ``shard_liveness`` section."""
+        return {name: p.is_alive() for name, p in self._procs.items()}
+
+    def set_on_death(self, fn) -> None:
+        """Late-bind the watchdog death hook (the chaos harness builds
+        its observer after the runner, which already owns the ports)."""
+        self._on_death = fn
+
     # ---- lifecycle ---------------------------------------------------
     def start(self, timeout: float = 60.0) -> None:
+        from kubeflow_rm_tpu.controlplane import metrics
         for name in self._cfgs:
+            # materialise each shard's death counter at 0 now: a
+            # counter born at its first increment has no 0 -> 1 delta,
+            # so the shard-deaths burn rate could never see the death
+            metrics.SHARD_DEATHS_TOTAL.labels(shard=name)
             self._spawn(name)
         self.wait_ready(timeout)
         if self._supervise:
@@ -131,17 +152,29 @@ class ShardRunner:
         self.wait_ready(timeout, names=[name])
 
     def _watchdog(self) -> None:
+        from kubeflow_rm_tpu.controlplane import metrics
         while not self._stopping:
             time.sleep(0.2)
             for name, p in list(self._procs.items()):
                 if self._stopping or p.is_alive():
                     continue
+                exitcode = p.exitcode
                 log.warning("%s exited (code %s); respawning in place",
-                            name, p.exitcode)
+                            name, exitcode)
+                metrics.SHARD_DEATHS_TOTAL.labels(shard=name).inc()
+                respawned = False
                 with self._lock:
                     if not self._stopping and \
                             not self._procs[name].is_alive():
                         self._spawn(name)
+                        respawned = True
+                if respawned and self._on_death is not None:
+                    try:
+                        self._on_death(name, exitcode)
+                    except Exception:  # noqa: BLE001 - observer hook
+                        # must never take the watchdog down with it
+                        metrics.swallowed("shard.runner",
+                                          "on_death hook")
 
     def stop(self) -> None:
         self._stopping = True

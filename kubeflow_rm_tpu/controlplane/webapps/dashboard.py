@@ -49,6 +49,7 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
                prefix: str = "", links: dict | None = None,
                metrics_backend: str | None = None,
                history_interval_s: float = 10.0,
+               observer=None,
                **app_kwargs) -> WebApp:
     from kubeflow_rm_tpu.controlplane.webapps.metrics_service import (
         MetricsHistory, make_metrics_service,
@@ -63,6 +64,15 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
     history = MetricsHistory(metrics_svc,
                              interval_s=history_interval_s)
     app.metrics_history = history
+    if observer is None:
+        # TSDB + SLO engine + flight recorder over the same registry
+        # the facade reads; ticked on demand from /api/alerts (no
+        # thread spawned by construction — callers that want the
+        # background loop call app.observer.start())
+        from kubeflow_rm_tpu.controlplane import obs
+        observer = obs.Observer(
+            shard_urls=getattr(api, "shard_urls", None))
+    app.observer = observer
 
     # ---- api.ts surface ---------------------------------------------
     @app.route("/api/namespaces")
@@ -112,6 +122,17 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         ring of snapshots sampled in-process)."""
         return {"interval_s": history.interval_s,
                 "series": history.series()}
+
+    @app.route("/api/alerts")
+    def get_alerts(req):
+        """The SLO engine's view: every declared objective with its
+        multi-window burn rates and alert state, the active (non-ok)
+        alert set, the transition log, and TSDB/flight-recorder health
+        counters. Each read ticks the observer at most once per
+        sampling interval, so the endpoint is live without a
+        background thread."""
+        observer.maybe_tick()
+        return observer.alerts()
 
     # ---- distributed traces -----------------------------------------
     def _merged_spans():

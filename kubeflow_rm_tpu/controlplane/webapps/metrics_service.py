@@ -249,6 +249,14 @@ def _controlplane_section(api=None) -> dict:
                     "serving_request_latency_seconds_sum"),
             },
         },
+        # error accounting: intentionally-absorbed exceptions (KFRM005
+        # counts them instead of letting them vanish); per-module split
+        # lives in the labelled /metrics exposition, and the
+        # swallowed-errors SLO pages on a sustained nonzero rate
+        "errors": {
+            "swallowed": cp_metrics.registry_value(
+                "swallowed_errors_total"),
+        },
     }
 
 
@@ -469,6 +477,10 @@ class PrometheusMetricsService:
                         "seconds": g.get(
                             "serving_request_latency_seconds_sum"),
                     },
+                },
+                # module labels summed by the flat scrape
+                "errors": {
+                    "swallowed": g.get("swallowed_errors_total"),
                 },
             },
         }

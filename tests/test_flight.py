@@ -1,0 +1,139 @@
+"""Flight recorder: bundle contents, auto-trigger rate limiting, and
+the alert->critical wiring through the SLO engine."""
+
+import json
+
+from kubeflow_rm_tpu.controlplane.obs.flight import (
+    SCHEMA_VERSION, FlightRecorder)
+from kubeflow_rm_tpu.controlplane.obs.runmeta import build_run_meta
+from kubeflow_rm_tpu.controlplane.obs.slo import (
+    GaugeSLO, SLOEngine, Window)
+from kubeflow_rm_tpu.controlplane.obs.timeseries import (
+    GAUGE, TimeSeriesDB)
+
+
+def _db():
+    return TimeSeriesDB(interval_s=1.0, window_s=600.0)
+
+
+def _critical_engine(db, base):
+    """Engine over a gauge burning at 2x, with points anchored to
+    ``base`` (the recorder cuts its window at wall-clock time)."""
+    slo = GaugeSLO(name="frag", metric="frag",
+                   windows=(Window(60.0, 10.0, 1.0, "critical"),),
+                   threshold=1.0)
+    eng = SLOEngine(db, [slo])
+    for t in range(0, 101, 5):
+        db.ingest(base - 100.0 + t, "frag", {}, GAUGE, 2.0)
+    return eng
+
+
+def test_bundle_contains_every_section():
+    import time
+    db = _db()
+    base = time.time()
+    eng = _critical_engine(db, base)
+    eng.evaluate(now=base)
+    fr = FlightRecorder(
+        db, eng, window_s=120.0,
+        liveness=lambda: {"shard-0": True, "shard-1": False},
+        run_meta=build_run_meta("test", {"scenario": "unit"}))
+    bundle = fr.trigger("chaos_scenario",
+                        detail={"scenario": "kill-a-shard"})
+    assert bundle["schema_version"] == SCHEMA_VERSION
+    assert bundle["trigger"]["reason"] == "chaos_scenario"
+    assert bundle["trigger"]["detail"]["scenario"] == "kill-a-shard"
+    assert bundle["run_meta"]["harness"] == "test"
+    # trailing metric window made it in
+    assert any(s["name"] == "frag" and s["points"]
+               for s in bundle["metrics"])
+    # the fired alert rides along
+    assert [a["slo"] for a in bundle["alerts"]["active"]] == ["frag"]
+    assert bundle["shard_liveness"] == {"shard-0": True,
+                                        "shard-1": False}
+    assert isinstance(bundle["slow_traces"], list)
+    assert fr.last() is bundle
+
+
+def test_auto_triggers_are_rate_limited_explicit_are_not():
+    fr = FlightRecorder(min_interval_s=3600.0)
+    assert fr.trigger("alert_critical", auto=True) is not None
+    # same flapping alert seconds later: suppressed
+    assert fr.trigger("alert_critical", auto=True) is None
+    assert fr.suppressed_total == 1
+    # an operator-invoked dump always records
+    assert fr.trigger("chaos_scenario") is not None
+    assert fr.triggered_total == 2
+
+
+def test_engine_critical_transition_auto_triggers():
+    import time
+    db = _db()
+    base = time.time()
+    eng = _critical_engine(db, base)
+    fr = FlightRecorder(db, min_interval_s=0.0)
+    fr.attach_engine(eng)
+    eng.evaluate(now=base)
+    bundle = fr.last()
+    assert bundle is not None
+    assert bundle["trigger"]["reason"] == "alert_critical"
+    assert bundle["trigger"]["detail"]["slo"] == "frag"
+    assert bundle["trigger"]["detail"]["to"] == "critical"
+
+
+def test_warning_transition_does_not_trigger():
+    db = _db()
+    slo = GaugeSLO(name="frag", metric="frag",
+                   windows=(Window(60.0, 10.0, 1.0, "warning"),),
+                   threshold=1.0)
+    eng = SLOEngine(db, [slo])
+    for t in range(0, 101, 5):
+        db.ingest(float(t), "frag", {}, GAUGE, 2.0)
+    fr = FlightRecorder(db, min_interval_s=0.0)
+    fr.attach_engine(eng)
+    eng.evaluate(now=100.0)
+    assert fr.last() is None
+
+
+def test_keep_bounds_bundle_ring():
+    fr = FlightRecorder(keep=3)
+    for i in range(5):
+        fr.trigger(f"r{i}")
+    reasons = [b["trigger"]["reason"] for b in fr.bundles()]
+    assert reasons == ["r2", "r3", "r4"]
+    assert fr.triggered_total == 5
+
+
+def test_liveness_failure_is_swallowed_not_raised():
+    def boom():
+        raise RuntimeError("runner torn down")
+    fr = FlightRecorder(liveness=boom)
+    bundle = fr.trigger("chaos_scenario")
+    assert bundle["shard_liveness"] is None
+
+
+def test_dump_json_roundtrips(tmp_path):
+    fr = FlightRecorder(run_meta=build_run_meta("test", {}))
+    fr.trigger("chaos_scenario")
+    path = fr.dump_json(str(tmp_path / "FLIGHT_test.json"))
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["schema_version"] == SCHEMA_VERSION
+    assert loaded["trigger"]["reason"] == "chaos_scenario"
+
+
+def test_observer_wires_the_stack_together():
+    from kubeflow_rm_tpu.controlplane import obs
+    o = obs.Observer(interval_s=1.0,
+                     liveness=lambda: {"shard-0": True})
+    o.tick(now=100.0)
+    snap = o.alerts()
+    assert {"slos", "active", "transitions", "tsdb", "flight"} <= \
+        set(snap)
+    assert snap["tsdb"]["series"] > 0
+    # shard death path: ticks, then records a bundle with the reason
+    o.on_shard_death("shard-0", -9)
+    bundle = o.flight.last()
+    assert bundle["trigger"]["reason"] == "shard_death"
+    assert bundle["trigger"]["detail"] == {"shard": "shard-0",
+                                           "exitcode": -9}

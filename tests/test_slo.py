@@ -1,0 +1,226 @@
+"""SLO burn-rate math against hand fixtures, and the alert state
+machine: escalation, hysteresis hold, and no-flap under a series that
+oscillates around the threshold."""
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane.obs.slo import (
+    GaugeSLO, LatencySLO, RateSLO, SLOEngine, Window, default_slos)
+from kubeflow_rm_tpu.controlplane.obs.timeseries import (
+    BUCKET, COUNTER, GAUGE, TimeSeriesDB)
+
+WIN = (Window(60.0, 10.0, 1.0, "critical"),)
+
+
+def _db():
+    return TimeSeriesDB(interval_s=1.0, window_s=600.0)
+
+
+def _hist(db, name, t0, t1, incs, labels=None):
+    import math
+    les = sorted(incs, key=lambda x: math.inf if x == "+Inf"
+                 else float(x))
+    run = 0.0
+    for le in les:
+        run += incs[le]
+        lbl = dict(labels or {})
+        lbl["le"] = le
+        db.ingest(t0, name + "_bucket", lbl, BUCKET, 0.0)
+        db.ingest(t1, name + "_bucket", lbl, BUCKET, run)
+
+
+# ---- burn-rate math ---------------------------------------------------
+
+def test_latency_burn_is_bad_fraction_over_budget():
+    db = _db()
+    # 90/100 under 0.5s against a 90% target: bad_frac 0.1, budget
+    # 0.1 -> burning at exactly 1.0x
+    _hist(db, "lat_seconds", 0.0, 10.0, {"0.5": 90.0, "+Inf": 10.0})
+    slo = LatencySLO(name="l", metric="lat_seconds", windows=WIN,
+                     threshold_s=0.5, target=0.90)
+    assert slo.burn_rate(db, 100.0, now=10.0) == pytest.approx(1.0)
+
+
+def test_latency_burn_scales_with_badness():
+    db = _db()
+    # 70/100 under threshold: bad_frac 0.3 over a 0.1 budget -> 3x
+    _hist(db, "lat_seconds", 0.0, 10.0, {"0.5": 70.0, "+Inf": 30.0})
+    slo = LatencySLO(name="l", metric="lat_seconds", windows=WIN,
+                     threshold_s=0.5, target=0.90)
+    assert slo.burn_rate(db, 100.0, now=10.0) == pytest.approx(3.0)
+
+
+def test_latency_burn_none_without_traffic():
+    db = _db()
+    slo = LatencySLO(name="l", metric="lat_seconds", windows=WIN,
+                     threshold_s=0.5, target=0.90)
+    assert slo.burn_rate(db, 100.0, now=10.0) is None
+
+
+def test_rate_burn_is_rate_over_allowance():
+    db = _db()
+    # 6 swallows over 60s = 0.1/s against an allowance of 0.05/s
+    db.ingest(0.0, "swallowed_errors_total", {}, COUNTER, 0.0)
+    db.ingest(60.0, "swallowed_errors_total", {}, COUNTER, 6.0)
+    slo = RateSLO(name="r", metric="swallowed_errors_total",
+                  windows=WIN, allowed_per_s=0.05)
+    assert slo.burn_rate(db, 100.0, now=60.0) == pytest.approx(2.0)
+
+
+def test_gauge_burn_is_windowed_mean_over_threshold():
+    db = _db()
+    for t in range(0, 60, 10):
+        db.ingest(float(t), "scheduler_fragmentation", {}, GAUGE, 0.75)
+    slo = GaugeSLO(name="g", metric="scheduler_fragmentation",
+                   windows=WIN, threshold=0.5)
+    assert slo.burn_rate(db, 100.0, now=60.0) == pytest.approx(1.5)
+
+
+def test_gauge_burn_ignores_transient_spike():
+    db = _db()
+    # single 1.0 spike in a sea of 0.0: mean stays under threshold
+    for t in range(0, 100, 10):
+        db.ingest(float(t), "frag", {}, GAUGE,
+                  1.0 if t == 50 else 0.0)
+    slo = GaugeSLO(name="g", metric="frag", windows=WIN, threshold=0.5)
+    assert slo.burn_rate(db, 200.0, now=100.0) < 1.0
+
+
+# ---- engine state machine ---------------------------------------------
+
+def _gauge_engine(hold_s=30.0):
+    db = _db()
+    slo = GaugeSLO(name="frag", metric="frag", windows=WIN,
+                   threshold=1.0)
+    eng = SLOEngine(db, [slo], clear_ratio=0.8, hold_s=hold_s)
+    return db, eng
+
+
+def _fill(db, t0, t1, value, step=5.0):
+    t = t0
+    while t <= t1:
+        db.ingest(t, "frag", {}, GAUGE, value)
+        t += step
+
+
+def test_engine_escalates_when_both_windows_burn():
+    db, eng = _gauge_engine()
+    _fill(db, 0.0, 100.0, 2.0)
+    fired = eng.evaluate(now=100.0)
+    assert [(tr["from"], tr["to"]) for tr in fired] == \
+        [("ok", "critical")]
+    assert eng.state_of("frag") == "critical"
+    # burns recorded per window length
+    assert fired[0]["burns"]["60s"] == pytest.approx(2.0)
+    assert fired[0]["burns"]["10s"] == pytest.approx(2.0)
+
+
+def test_engine_needs_long_AND_short_window():
+    db, eng = _gauge_engine()
+    # long window hot, short window already recovered: no page
+    _fill(db, 0.0, 80.0, 2.0)
+    _fill(db, 85.0, 100.0, 0.0)
+    assert eng.evaluate(now=100.0) == []
+    assert eng.state_of("frag") == "ok"
+
+
+def test_engine_hysteresis_holds_before_clearing():
+    db, eng = _gauge_engine(hold_s=30.0)
+    _fill(db, 0.0, 100.0, 2.0)
+    eng.evaluate(now=100.0)
+    assert eng.state_of("frag") == "critical"
+    # full recovery; ring rolls over so the 60s window reads 0.0
+    _fill(db, 100.0, 300.0, 0.0)
+    assert eng.evaluate(now=250.0) == []     # starts the below clock
+    assert eng.evaluate(now=270.0) == []     # 20s below < hold 30s
+    assert eng.state_of("frag") == "critical"
+    fired = eng.evaluate(now=281.0)          # 31s below -> clears
+    assert [(tr["from"], tr["to"]) for tr in fired] == \
+        [("critical", "ok")]
+    assert eng.state_of("frag") == "ok"
+
+
+def test_engine_does_not_flap_around_the_boundary():
+    db, eng = _gauge_engine(hold_s=30.0)
+    _fill(db, 0.0, 100.0, 2.0)
+    eng.evaluate(now=100.0)
+    # oscillate the mean inside the dead band (clear floor 0.8 ..
+    # threshold 1.0): desired flips to ok but never clears, severity
+    # never drops, and no transition ever fires
+    transitions = []
+    _fill(db, 100.0, 400.0, 0.9)
+    for now in range(160, 400, 10):
+        transitions += eng.evaluate(now=float(now))
+    assert transitions == []
+    assert eng.state_of("frag") == "critical"
+
+
+def test_engine_reescalates_if_burn_returns_during_hold():
+    db, eng = _gauge_engine(hold_s=30.0)
+    _fill(db, 0.0, 100.0, 2.0)
+    eng.evaluate(now=100.0)
+    _fill(db, 100.0, 200.0, 0.0)
+    eng.evaluate(now=170.0)                  # below clock starts
+    # burn comes back before hold elapses: clock must reset
+    _fill(db, 200.0, 260.0, 2.0)
+    eng.evaluate(now=260.0)
+    _fill(db, 260.0, 400.0, 0.0)
+    assert eng.evaluate(now=330.0) == []     # below again, clock fresh
+    assert eng.state_of("frag") == "critical"
+    fired = eng.evaluate(now=365.0)
+    assert [(tr["from"], tr["to"]) for tr in fired] == \
+        [("critical", "ok")]
+
+
+def test_warning_then_critical_ladder():
+    db = _db()
+    slo = GaugeSLO(name="frag", metric="frag",
+                   windows=(Window(60.0, 10.0, 2.0, "critical"),
+                            Window(60.0, 10.0, 1.0, "warning")),
+                   threshold=1.0)
+    eng = SLOEngine(db, [slo])
+    _fill(db, 0.0, 100.0, 1.5)
+    fired = eng.evaluate(now=100.0)
+    assert [(tr["from"], tr["to"]) for tr in fired] == \
+        [("ok", "warning")]
+    _fill(db, 100.0, 300.0, 3.0)
+    fired = eng.evaluate(now=300.0)
+    assert [(tr["from"], tr["to"]) for tr in fired] == \
+        [("warning", "critical")]
+
+
+def test_snapshot_exposes_active_alerts_and_transitions():
+    db, eng = _gauge_engine()
+    _fill(db, 0.0, 100.0, 2.0)
+    eng.evaluate(now=100.0)
+    snap = eng.snapshot()
+    assert [a["slo"] for a in snap["active"]] == ["frag"]
+    assert snap["active"][0]["state"] == "critical"
+    assert len(snap["transitions"]) == 1
+    [spec] = snap["slos"]
+    assert spec["kind"] == "GaugeSLO" and spec["state"] == "critical"
+
+
+def test_callbacks_fire_outside_lock_with_transition():
+    db, eng = _gauge_engine()
+    seen = []
+    eng.on_transition(seen.append)
+    _fill(db, 0.0, 100.0, 2.0)
+    eng.evaluate(now=100.0)
+    assert len(seen) == 1 and seen[0]["to"] == "critical"
+
+
+# ---- shipped SLO set --------------------------------------------------
+
+def test_default_slos_cover_the_issue_set():
+    names = {s.name for s in default_slos()}
+    assert {"provision-p50", "serving-victim-p95", "scheduler-latency",
+            "wal-fsync", "swallowed-errors", "scheduler-fragmentation",
+            "shard-deaths"} <= names
+
+
+def test_default_slos_evaluate_clean_on_empty_tsdb():
+    db = _db()
+    eng = SLOEngine(db, default_slos())
+    assert eng.evaluate(now=100.0) == []
+    assert all(s["state"] == "ok" for s in eng.snapshot()["slos"])

@@ -1,0 +1,241 @@
+"""TSDB unit coverage: exposition parsing, ring eviction under label
+cardinality growth, and the reduction math (rate / percentile /
+bad_fraction) checked against hand-computed fixtures."""
+
+import math
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane.obs.timeseries import (
+    BUCKET, COUNTER, GAUGE, TimeSeriesDB, parse_exposition)
+
+
+def _db(**kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("window_s", 600.0)
+    return TimeSeriesDB(**kw)
+
+
+# ---- exposition parsing ----------------------------------------------
+
+def test_parse_exposition_keeps_labels_and_kinds():
+    text = """\
+# HELP wal_fsync_seconds WAL fsync latency
+# TYPE wal_fsync_seconds histogram
+wal_fsync_seconds_bucket{le="0.05",shard="shard-0"} 12.0
+wal_fsync_seconds_bucket{le="+Inf",shard="shard-0"} 14.0
+wal_fsync_seconds_count{shard="shard-0"} 14.0
+wal_fsync_seconds_sum{shard="shard-0"} 0.42
+wal_fsync_seconds_created{shard="shard-0"} 1.7e+09
+# TYPE workqueue_depth gauge
+workqueue_depth{name="notebook"} 3.0
+# TYPE api_requests counter
+api_requests_total{verb="POST"} 9.0
+not a sample line
+bad_value_metric NaN
+"""
+    got = parse_exposition(text)
+    by_name = {}
+    for name, labels, kind, value in got:
+        by_name.setdefault(name, []).append((labels, kind, value))
+    # _created and NaN dropped, junk line skipped
+    assert "wal_fsync_seconds_created" not in by_name
+    assert "bad_value_metric" not in by_name
+    assert by_name["wal_fsync_seconds_bucket"][0] == (
+        {"le": "0.05", "shard": "shard-0"}, BUCKET, 12.0)
+    assert by_name["wal_fsync_seconds_count"][0][1] == COUNTER
+    assert by_name["workqueue_depth"][0] == (
+        {"name": "notebook"}, GAUGE, 3.0)
+    assert by_name["api_requests_total"][0] == (
+        {"verb": "POST"}, COUNTER, 9.0)
+
+
+def test_parse_exposition_unescapes_label_values():
+    text = ('# TYPE m gauge\n'
+            'm{msg="say \\"hi\\"",p="a\\\\b"} 1.0\n')
+    [(name, labels, kind, value)] = parse_exposition(text)
+    assert labels == {"msg": 'say "hi"', "p": "a\\b"}
+
+
+# ---- ring eviction under cardinality growth ---------------------------
+
+def test_eviction_caps_series_under_label_cardinality_growth():
+    db = _db(max_series=16)
+    # a misbehaving label (say user id) spraying 50 distinct series
+    for i in range(50):
+        db.ingest(float(i), "cardinality_bomb", {"uid": f"u{i}"},
+                  GAUGE, float(i))
+    assert db.series_count() == 16
+    assert db.evictions == 50 - 16
+    # least-recently-updated evicted first: early uids gone, late kept
+    assert db.latest("cardinality_bomb", {"uid": "u0"}) is None
+    assert db.latest("cardinality_bomb", {"uid": "u49"}) == 49.0
+
+
+def test_eviction_prefers_stale_series_not_hot_ones():
+    db = _db(max_series=4)
+    # a hot series updated on every pass survives a concurrent flood
+    # of one-shot series — the flood evicts its own stale members
+    for t in range(10):
+        db.ingest(float(t), "hot", {}, GAUGE, 1.0)
+        db.ingest(float(t), "flood", {"i": str(t)}, GAUGE, 0.0)
+    assert db.latest("hot") == 1.0
+    assert db.latest("flood", {"i": "0"}) is None
+
+
+def test_ring_bounds_points_per_series():
+    db = TimeSeriesDB(interval_s=1.0, window_s=10.0, max_points=8)
+    for t in range(100):
+        db.ingest(float(t), "g", {}, GAUGE, float(t))
+    [series] = db.range("g", window_s=1000.0, now=100.0)
+    assert len(series["points"]) == 8
+    assert series["points"][-1] == [99.0, 99.0]
+
+
+# ---- counter rate -----------------------------------------------------
+
+def test_rate_is_windowed_per_second_delta():
+    db = _db()
+    for t, v in [(0, 0.0), (10, 5.0), (20, 15.0)]:
+        db.ingest(float(t), "reqs_total", {}, COUNTER, v)
+    # 15 increments over 20s
+    assert db.rate("reqs_total", window_s=100.0, now=20.0) == \
+        pytest.approx(0.75)
+    # trailing 10s window sees only the last two points: 10/10
+    assert db.rate("reqs_total", window_s=10.0, now=20.0) == \
+        pytest.approx(1.0)
+
+
+def test_rate_survives_counter_reset():
+    db = _db()
+    # process restart: 100 -> 0 -> 30; only positive deltas count
+    for t, v in [(0, 90.0), (10, 100.0), (20, 0.0), (30, 30.0)]:
+        db.ingest(float(t), "reqs_total", {}, COUNTER, v)
+    assert db.rate("reqs_total", window_s=100.0, now=30.0) == \
+        pytest.approx((10.0 + 30.0) / 30.0)
+
+
+def test_rate_none_without_two_points():
+    db = _db()
+    assert db.rate("nope", now=0.0) is None
+    db.ingest(0.0, "one", {}, COUNTER, 5.0)
+    assert db.rate("one", window_s=100.0, now=1.0) is None
+
+
+def test_rate_sums_across_federated_instances():
+    db = _db()
+    for inst in ("shard-0", "shard-1"):
+        for t, v in [(0, 0.0), (10, 10.0)]:
+            db.ingest(float(t), "reqs_total", {"instance": inst},
+                      COUNTER, v)
+    assert db.rate("reqs_total", window_s=100.0, now=10.0) == \
+        pytest.approx(2.0)
+    assert db.rate("reqs_total", {"instance": "shard-0"},
+                   window_s=100.0, now=10.0) == pytest.approx(1.0)
+
+
+# ---- histogram percentiles / bad_fraction -----------------------------
+
+def _ingest_hist(db, name, t0, t1, incs, labels=None):
+    """Two scrapes of a cumulative-bucket family whose windowed
+    increments are ``incs`` ({le: delta})."""
+    les = sorted(incs, key=lambda x: math.inf if x == "+Inf"
+                 else float(x))
+    run = 0.0
+    for le in les:
+        run += incs[le]
+        lbl = dict(labels or {})
+        lbl["le"] = le
+        db.ingest(t0, name + "_bucket", lbl, BUCKET, 0.0)
+        db.ingest(t1, name + "_bucket", lbl, BUCKET, run)
+
+
+def test_percentile_interpolates_inside_bucket():
+    db = _db()
+    # 50 events <=0.1, 30 in (0.1,0.5], 20 in (0.5,+Inf)
+    _ingest_hist(db, "lat_seconds", 0.0, 10.0,
+                 {"0.1": 50.0, "0.5": 30.0, "+Inf": 20.0})
+    # p50 lands exactly at the first bucket bound
+    assert db.percentile("lat_seconds", 0.5, window_s=100.0,
+                         now=10.0) == pytest.approx(0.1)
+    # p65: 15 of the 30 events in (0.1, 0.5] -> halfway through
+    assert db.percentile("lat_seconds", 0.65, window_s=100.0,
+                         now=10.0) == pytest.approx(0.3)
+    # p95 falls in +Inf: clamp to the last finite bound
+    assert db.percentile("lat_seconds", 0.95, window_s=100.0,
+                         now=10.0) == pytest.approx(0.5)
+
+
+def test_percentile_none_when_no_events():
+    db = _db()
+    assert db.percentile("lat_seconds", 0.5, now=0.0) is None
+    _ingest_hist(db, "flat_seconds", 0.0, 10.0,
+                 {"0.1": 0.0, "+Inf": 0.0})
+    assert db.percentile("flat_seconds", 0.5, window_s=100.0,
+                         now=10.0) is None
+
+
+def test_bad_fraction_hand_fixture():
+    db = _db()
+    _ingest_hist(db, "lat_seconds", 0.0, 10.0,
+                 {"0.1": 50.0, "0.5": 30.0, "+Inf": 20.0})
+    bad, total = db.bad_fraction("lat_seconds", 0.5,
+                                 window_s=100.0, now=10.0)
+    assert total == 100.0
+    assert bad == pytest.approx(0.2)       # the 20 events above 0.5
+    bad, _ = db.bad_fraction("lat_seconds", 0.1,
+                             window_s=100.0, now=10.0)
+    assert bad == pytest.approx(0.5)
+
+
+def test_bad_fraction_aggregates_across_shards():
+    db = _db()
+    _ingest_hist(db, "lat_seconds", 0.0, 10.0,
+                 {"0.1": 9.0, "+Inf": 1.0}, {"instance": "shard-0"})
+    _ingest_hist(db, "lat_seconds", 0.0, 10.0,
+                 {"0.1": 1.0, "+Inf": 9.0}, {"instance": "shard-1"})
+    bad, total = db.bad_fraction("lat_seconds", 0.1,
+                                 window_s=100.0, now=10.0)
+    assert total == 20.0
+    assert bad == pytest.approx(0.5)
+
+
+# ---- gauges / dump ----------------------------------------------------
+
+def test_latest_sums_like_registry_value():
+    db = _db()
+    db.ingest(0.0, "free_chips", {"pool": "a"}, GAUGE, 4.0)
+    db.ingest(0.0, "free_chips", {"pool": "b"}, GAUGE, 8.0)
+    assert db.latest("free_chips") == 12.0
+    assert db.latest("free_chips", {"pool": "a"}) == 4.0
+
+
+def test_gauge_avg_is_windowed_mean():
+    db = _db()
+    for t, v in [(0, 0.0), (10, 0.5), (20, 1.0)]:
+        db.ingest(float(t), "frag", {}, GAUGE, v)
+    assert db.gauge_avg("frag", window_s=100.0, now=20.0) == \
+        pytest.approx(0.5)
+    assert db.gauge_avg("frag", window_s=10.0, now=20.0) == \
+        pytest.approx(0.75)
+
+
+def test_dump_trims_to_window():
+    db = _db()
+    for t in range(20):
+        db.ingest(float(t), "g", {}, GAUGE, float(t))
+    dump = db.dump(window_s=5.0, now=19.0)
+    [series] = [s for s in dump if s["name"] == "g"]
+    assert [p[0] for p in series["points"]] == [14.0, 15.0, 16.0,
+                                                17.0, 18.0, 19.0]
+
+
+def test_sample_reads_the_live_registry():
+    # end-to-end: the real metrics registry flows into the ring
+    from kubeflow_rm_tpu.controlplane import metrics
+    db = TimeSeriesDB()
+    metrics.SWALLOWED_ERRORS_TOTAL.labels(module="tsdbtest").inc()
+    n = db.sample(now=1.0)
+    assert n > 0
+    assert db.latest("swallowed_errors_total",
+                     {"module": "tsdbtest"}) >= 1.0

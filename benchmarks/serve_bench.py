@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """HTTP serving throughput + speculative-decode workload bench.
 
-Four campaigns, each printing one JSON line:
+Five campaigns, each printing one JSON line:
 
 - ``serve``: boot ``examples/serve_llama.py``'s app in-process on a
   synthetic-weight model (``--preset`` / ``--quant``), fire N requests
@@ -27,6 +27,12 @@ Four campaigns, each printing one JSON line:
   p50/p95, aggregate USEFUL tokens/sec (tokens a request asked for —
   the static arm decodes its server-fixed budget regardless), batch
   occupancy, queue depth, and shed counts. Feeds ``SERVE_r01.json``.
+- ``prefix_storm``: the r13 prefix-heavy storm — 80% of requests open
+  with one long shared system prompt, replayed against the block-paged
+  engine (CoW prefix sharing) and the r12 contiguous engine on the
+  same host/weights, plus a ServingFleet chaos pass that hard-kills a
+  replica mid-storm (every request must migrate and finish exactly).
+  Feeds ``SERVE_r02.json``.
 """
 
 from __future__ import annotations
@@ -507,10 +513,314 @@ def storm_campaign(preset: str, quant: str | None, tenants: int,
     }
 
 
+def prefix_storm_campaign(preset: str, quant: str | None, tenants: int,
+                          reqs_per_tenant: int, flood_threads: int,
+                          flood_reqs: int, slots: int, slot_len: int,
+                          block_size: int, shared_len: int,
+                          chaos_replicas: int,
+                          overrides: dict | None = None) -> dict:
+    """The r13 prefix-heavy storm: 80% of traffic opens with one long
+    shared system prompt, replayed against two same-host arms sharing
+    one set of weights:
+
+    - ``paged``: the block-paged engine (``paged=True``) — the shared
+      prefix is content-addressed in the block pool, so repeat prompts
+      adopt the cached blocks and prefill only their short tail.
+    - ``contiguous``: the r12 contiguous-slot engine (``paged=False``)
+      on the SAME traffic — every request re-prefills the full prompt.
+
+    Victims submit as ``interactive``, the flood as ``best_effort``,
+    so the in-engine weighted queues (not gateway-side shedding) set
+    the victim p95. Both arms run ``admission=False``: nothing sheds,
+    every request completes, and useful tok/s compares the engines —
+    not the admission policy. Each arm also answers one known prompt
+    at the end and checks it bit-identical to solo ``generate_fused``.
+
+    A third ``chaos`` pass runs the paged engine as a
+    ``ServingFleet`` of N replicas and hard-kills the affinity owner
+    mid-storm: every in-flight request must migrate and finish with
+    exactly the tokens an uninterrupted run produces — zero failures.
+    """
+    import logging
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from werkzeug.serving import make_server
+
+    logging.getLogger("werkzeug").setLevel(logging.ERROR)
+
+    from kubeflow_rm_tpu.controlplane.serving_fleet import ServingFleet
+    from kubeflow_rm_tpu.controlplane.webapps.serving import (
+        ServingGateway, make_serving_app,
+    )
+    from kubeflow_rm_tpu.models import (
+        ContinuousBatchingEngine, LlamaConfig, init_params,
+    )
+    from kubeflow_rm_tpu.models.generate import generate_fused
+
+    cfg = getattr(LlamaConfig, preset)(**(overrides or {}))
+    if quant:
+        from kubeflow_rm_tpu.models.quantize import init_params_quantized
+        params = init_params_quantized(cfg, jax.random.key(0),
+                                       bits=4 if quant == "int4" else 8)
+    else:
+        params = init_params(cfg, jax.random.key(0))
+
+    budgets = (4, 8)
+    rng = np.random.default_rng(13)
+    # the one system prompt 80% of traffic opens with; tails of 4-8
+    # keep every shared request inside a single small suffix bucket
+    shared_sys = rng.integers(1, cfg.vocab_size,
+                              size=shared_len).tolist()
+
+    def one_request():
+        if rng.random() < 0.8:
+            tail = rng.integers(1, cfg.vocab_size,
+                                size=int(rng.integers(4, 9))).tolist()
+            return shared_sys + tail, True
+        p = rng.integers(1, cfg.vocab_size,
+                         size=int(rng.integers(shared_len,
+                                               shared_len + 9))).tolist()
+        return p, False
+
+    schedule: dict[str, list] = {}
+    shared_n = total_n = 0
+    for t in range(tenants):
+        work = []
+        for _ in range(reqs_per_tenant):
+            p, is_shared = one_request()
+            shared_n += is_shared
+            total_n += 1
+            work.append((p, int(budgets[rng.integers(0, len(budgets))]),
+                         0.02))
+        schedule[f"tenant-{t}"] = work
+    flood_work = []
+    for _ in range(flood_reqs):
+        p, is_shared = one_request()
+        shared_n += is_shared
+        total_n += 1
+        flood_work.append(
+            (p, int(budgets[rng.integers(0, len(budgets))])))
+
+    def run_storm(url: str) -> tuple[list[dict], float]:
+        results: list[dict] = []
+        lock = threading.Lock()
+
+        def call(tenant, prompt, m, slo_class):
+            body = {"prompt": prompt, "tenant": tenant,
+                    "max_new_tokens": m, "slo_class": slo_class}
+            t0 = time.perf_counter()
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                resp = json.loads(
+                    urllib.request.urlopen(req, timeout=600).read())
+                ok = bool(resp["tokens"])
+            except urllib.error.HTTPError:
+                ok = False
+            lat = time.perf_counter() - t0
+            with lock:
+                results.append({"tenant": tenant, "ok": ok,
+                                "useful": m if ok else 0,
+                                "lat_ms": lat * 1e3})
+
+        def victim(name):
+            for prompt, m, gap in schedule[name]:
+                call(name, prompt, m, "interactive")
+                time.sleep(gap)
+
+        def flooder(i):
+            for j in range(i, len(flood_work), flood_threads):
+                call("flood", *flood_work[j], "best_effort")
+
+        ts = ([threading.Thread(target=victim, args=(n,))
+               for n in schedule]
+              + [threading.Thread(target=flooder, args=(i,))
+                 for i in range(flood_threads)])
+        t0 = time.perf_counter()
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        return results, time.perf_counter() - t0
+
+    def summarize(results, wall) -> dict:
+        def pct(v, q):
+            return round(v[min(len(v) - 1, int(q * (len(v) - 1)))], 1)
+
+        per_tenant = {}
+        for name in sorted({r["tenant"] for r in results}):
+            lats = sorted(r["lat_ms"] for r in results
+                          if r["tenant"] == name and r["ok"])
+            per_tenant[name] = {
+                "ok": len(lats),
+                "p50_ms": pct(lats, 0.50) if lats else None,
+                "p95_ms": pct(lats, 0.95) if lats else None,
+            }
+        victim_p95 = [v["p95_ms"] for k, v in per_tenant.items()
+                      if k != "flood" and v["p95_ms"] is not None]
+        return {
+            "wall_s": round(wall, 2),
+            "ok": sum(1 for r in results if r["ok"]),
+            "failed": sum(1 for r in results if not r["ok"]),
+            "useful_tokens": sum(r["useful"] for r in results),
+            "useful_tok_per_s": round(
+                sum(r["useful"] for r in results) / wall, 1),
+            "victim_p95_ms_worst": max(victim_p95) if victim_p95
+            else None,
+            "per_tenant": per_tenant,
+        }
+
+    def solo(prompt, budget):
+        ref = generate_fused(params, cfg,
+                             jnp.asarray([prompt], jnp.int32),
+                             max_new_tokens=budget, max_len=slot_len)
+        return np.asarray(ref)[0, len(prompt):].tolist()
+
+    check_prompt = shared_sys + [1, 2, 3, 4]
+    check_want = solo(check_prompt, 8)
+
+    def engine_arm(paged: bool) -> dict:
+        engine = ContinuousBatchingEngine(params, cfg, slots=slots,
+                                          slot_len=slot_len, paged=paged,
+                                          block_size=block_size)
+        gw = ServingGateway(engine, max_queue=100_000, admission=False)
+        app = make_serving_app(gw, cfg)
+        httpd = make_server("127.0.0.1", 0, app, threaded=True)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_port}/generate"
+
+        def post(prompt, m):
+            req = urllib.request.Request(
+                url, data=json.dumps(
+                    {"prompt": prompt, "tenant": "warm",
+                     "max_new_tokens": m}).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(
+                urllib.request.urlopen(req, timeout=600).read())
+
+        # warm BOTH prefill paths before the timed region: a full-miss
+        # prompt (big bucket, registers the shared chain) and a
+        # shared-prefix sibling (small suffix bucket on the paged arm)
+        post(list(shared_sys) + [9, 9, 9, 9], 4)
+        post(list(shared_sys) + [9, 9, 9, 8], 4)          # 4-token tail
+        post(list(shared_sys) + [9, 8, 7, 6, 5, 4, 3, 2], 4)  # 8-token
+        post([1 + i % (cfg.vocab_size - 2)
+              for i in range(shared_len + 3)], 4)
+
+        results, wall = run_storm(url)
+        got = post(check_prompt, 8)["tokens"]
+        st = engine.stats()
+        snap = gw.snapshot()
+        httpd.shutdown()
+        gw.close()
+        out = summarize(results, wall)
+        out.update({
+            "paged": paged,
+            "sample_exact": got == check_want,
+            "batch_occupancy": round(snap["batch_occupancy"], 3),
+            "decode_steps": snap["decode_steps"],
+        })
+        if paged:
+            out.update({
+                "prefix_hit_ratio": st["prefix_hit_ratio"],
+                "prefix_hit_tokens": st["prefix_hit_tokens"],
+                "cow_forks": st["cow_forks"],
+                "block_evictions": st["evictions"],
+            })
+        return out
+
+    def chaos_arm() -> dict:
+        fleet = ServingFleet({
+            f"r{i}": ServingGateway(
+                ContinuousBatchingEngine(params, cfg, slots=slots,
+                                         slot_len=slot_len,
+                                         block_size=block_size),
+                max_queue=100_000, admission=False)
+            for i in range(chaos_replicas)})
+        try:
+            prompts = [shared_sys + [7, 7, 7, i] for i in range(6)] \
+                + [[3 + i % (cfg.vocab_size - 4)
+                    for i in range(shared_len + 4)], shared_sys[::-1]]
+            want = {i: solo(p, 12) for i, p in enumerate(prompts)}
+            jobs = [(i % len(prompts)) for i in range(3 * len(prompts))]
+            results: list = [None] * len(jobs)
+
+            def go(j):
+                results[j] = fleet.submit_and_wait(
+                    "chaos", list(prompts[jobs[j]]), max_new_tokens=12,
+                    slo_class="interactive")
+
+            victim = fleet.route(prompts[0])
+            ts = [threading.Thread(target=go, args=(j,))
+                  for j in range(len(jobs))]
+            t0 = time.perf_counter()
+            for th in ts:
+                th.start()
+            # hard-kill the affinity owner the moment it holds work
+            gw = fleet.gateways[victim]
+            deadline = time.monotonic() + 60
+            while (not gw.engine.active_slots
+                   and time.monotonic() < deadline):
+                time.sleep(0.001)
+            fleet.kill(victim)
+            for th in ts:
+                th.join()
+            wall = time.perf_counter() - t0
+            failed = sum(1 for r in results
+                         if r is None or r[0] is None)
+            exact = sum(1 for j, r in enumerate(results)
+                        if r is not None and r[0] == want[jobs[j]])
+            return {
+                "replicas": chaos_replicas,
+                "killed": victim,
+                "requests": len(jobs),
+                "failed": failed,
+                "exact": exact,
+                "all_exact": exact == len(jobs),
+                "migrations": fleet.migrations,
+                "wall_s": round(wall, 2),
+            }
+        finally:
+            fleet.close()
+
+    paged = engine_arm(True)
+    contiguous = engine_arm(False)
+    chaos = chaos_arm()
+    speedup = round(paged["useful_tok_per_s"]
+                    / max(1e-9, contiguous["useful_tok_per_s"]), 2)
+    return {
+        "metric": "serving_prefix_storm",
+        "model": f"llama-{preset}" + (f" {quant}" if quant else " bf16")
+                 + (f" {overrides}" if overrides else ""),
+        "device": _device_tag(),
+        "workload": {
+            "victim_tenants": tenants,
+            "reqs_per_tenant": reqs_per_tenant,
+            "flood_threads": flood_threads,
+            "flood_reqs": flood_reqs,
+            "shared_prefix_len": shared_len,
+            "shared_fraction": round(shared_n / max(1, total_n), 3),
+            "budgets": list(budgets),
+            "slots": slots, "slot_len": slot_len,
+            "block_size": block_size,
+        },
+        "arms": {"paged": paged, "contiguous": contiguous},
+        "paged_speedup": speedup,
+        "paged_ge_2x": speedup >= 2.0,
+        "chaos": chaos,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("campaign", choices=["serve", "spec", "decode",
-                                         "storm"])
+                                         "storm", "prefix_storm"])
     ap.add_argument("--preset", default="bench_1b")
     ap.add_argument("--quant", choices=["int8", "int4"], default=None)
     ap.add_argument("--requests", type=int, default=32)
@@ -536,6 +846,13 @@ def main() -> int:
     ap.add_argument("--qps", type=float, default=25.0,
                     help="per-tenant admitted request rate (storm)")
     ap.add_argument("--burst", type=int, default=30)
+    # prefix_storm campaign knobs
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="paged-KV block size (prefix_storm)")
+    ap.add_argument("--shared-len", type=int, default=88,
+                    help="shared system-prompt length (prefix_storm)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="fleet size for the chaos arm (prefix_storm)")
     ap.add_argument("--out", default=None,
                     help="also write the JSON to this path")
     args = ap.parse_args()
@@ -551,6 +868,16 @@ def main() -> int:
             "max_seq_len": args.seq_len}.items() if v is not None}
         out = decode_campaign(args.preset, args.batch, args.prompt_len,
                               args.max_new, overrides)
+    elif args.campaign == "prefix_storm":
+        overrides = {k: v for k, v in {
+            "dim": args.dim, "n_layers": args.layers,
+            "hidden_dim": args.hidden,
+            "max_seq_len": args.seq_len}.items() if v is not None}
+        out = prefix_storm_campaign(
+            args.preset, args.quant, args.tenants,
+            args.reqs_per_tenant, args.flood_threads, args.flood_reqs,
+            args.slots, args.slot_len, args.block_size,
+            args.shared_len, args.replicas, overrides)
     else:
         overrides = {k: v for k, v in {
             "dim": args.dim, "n_layers": args.layers,
@@ -575,6 +902,8 @@ def main() -> int:
             "reqs_per_tenant": args.reqs_per_tenant,
             "flood_threads": args.flood_threads, "slots": args.slots,
             "slo_ms": args.slo_ms, "qps": args.qps,
+            "slot_len": args.slot_len, "block_size": args.block_size,
+            "shared_len": args.shared_len, "replicas": args.replicas,
         },
         interleave_index=int(interleave) if interleave else None)
     print(json.dumps(out))

@@ -70,6 +70,7 @@ LOCK_HIERARCHY: dict[str, int] = {
     "readiness.registry": 410,
     "readiness.key": 420,           # per-notebook condvar family
     "jupyter.hub_registry": 430,
+    "serving.fleet": 435,           # routes INTO gateways (440): uphill
     "serving.gateway": 440,
     "metrics_service.sampler_thread": 450,  # lazy sampler-thread start
     "metrics_service.sampler": 460,         # the history ring

@@ -395,6 +395,45 @@ SERVING_GENERATED_TOKENS_TOTAL = Counter(
     ["tenant"],
     registry=REGISTRY,
 )
+SERVING_CLASS_QUEUE_DEPTH = Gauge(
+    "serving_class_queue_depth",
+    "Engine admission-queue depth per SLO class (interactive | batch | "
+    "best_effort) — the weighted-round-robin backlog each class drains "
+    "from at token boundaries",
+    ["slo_class"],
+    registry=REGISTRY,
+)
+SERVING_PREFIX_HIT_RATIO = Gauge(
+    "serving_prefix_hit_ratio",
+    "Fraction of prompt tokens served from the shared-prefix block "
+    "cache instead of prefilled (cumulative since boot)",
+    registry=REGISTRY,
+)
+SERVING_PREFIX_MISS_RATIO = Gauge(
+    "serving_prefix_miss_ratio",
+    "1 - serving_prefix_hit_ratio, set only once prompts have flowed — "
+    "the burn signal for the prefix-hit-collapse SLO (sustained ~1.0 "
+    "under prefix-heavy traffic means the cache stopped working)",
+    registry=REGISTRY,
+)
+SERVING_FREE_BLOCK_FRACTION = Gauge(
+    "serving_free_block_fraction",
+    "Fraction of the paged-KV pool's usable blocks free or evictable "
+    "right now — sustained ~0 predicts admission OOM rejections",
+    registry=REGISTRY,
+)
+SERVING_MIGRATIONS_TOTAL = Counter(
+    "serving_migrations",
+    "In-flight requests re-routed to another replica after their "
+    "original replica drained or died (resumed, not failed)",
+    registry=REGISTRY,
+)
+SERVING_FLEET_REPLICAS = Gauge(
+    "serving_fleet_replicas",
+    "Serving-fleet replicas by state (ready | draining | dead)",
+    ["state"],
+    registry=REGISTRY,
+)
 
 # ---- observability loop: provision SLI + watchdog-visible deaths -----
 PROVISION_LATENCY_SECONDS = Histogram(

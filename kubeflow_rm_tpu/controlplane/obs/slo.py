@@ -171,6 +171,15 @@ def default_slos() -> list[SLO]:
             description="sustained fragmentation >= 0.5 means free "
                         "chips exist but no gang-sized hole does — "
                         "the ROADMAP-3 bin-packing signal"),
+        GaugeSLO(
+            name="serving-prefix-hit-collapse",
+            metric="serving_prefix_miss_ratio",
+            windows=warn_only, threshold=0.95,
+            description="sustained prefix-cache miss ratio >= 0.95 "
+                        "while prompts flow means the shared-prefix "
+                        "block cache stopped absorbing prefill "
+                        "(thrash/eviction storm, or affinity routing "
+                        "gone wrong) — the paged-KV speedup is gone"),
         RateSLO(
             name="shard-deaths", metric="shard_deaths_total",
             windows=(Window(120.0, 15.0, 1.0, "critical"),),

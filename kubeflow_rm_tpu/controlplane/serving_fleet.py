@@ -1,0 +1,333 @@
+"""Multi-replica serving fleet: cache-affinity routing + migration.
+
+Scale-OUT for the serving path (ROADMAP item 2c): N
+``ServingGateway``-wrapped engines behind one front door. Three
+routing rules, applied in order:
+
+1. **Cache affinity.** The routing key is the request's prompt-prefix
+   hash (first ``prefix_tokens`` token ids — one KV block's worth, the
+   same granularity ``models.paging`` content-addresses blocks at), so
+   requests sharing a system prompt land on the replica that already
+   holds those blocks and hit its prefix cache instead of re-prefilling.
+   The key rides the same consistent-hash ring as the control plane's
+   shard router (``shard/ring.py``): membership changes move only the
+   keys that must move.
+2. **Session stickiness.** A request carrying ``session`` routes by
+   ``s:<session>`` instead — every turn of a conversation returns to
+   the replica holding that conversation's KV blocks.
+3. **Load spill.** If the affinity owner's queue is ``spill_depth``
+   deep and a strictly shallower ready replica exists, the request
+   spills to the shallowest one — affinity is a preference, not a
+   hostage situation.
+
+Drain-aware rebalancing: the ring is built over READY replicas only
+and rebuilt when a replica drains or dies, so new traffic redistributes
+with minimal key movement. In-flight requests on a drained/killed
+replica are NOT failed: their ``wait`` raises ``ReplicaUnavailable``
+(with the tokens produced so far) and ``submit_and_wait`` resubmits
+``prompt + tokens_so_far`` with the remaining budget on another
+replica — greedy decode continues bit-identically, and the shared
+prefix cache on the new replica absorbs most of the re-prefill.
+
+Locking: ``serving.fleet`` (rank 435) guards only the state map and
+the cached ring; every blocking call (submit, wait, drain, close)
+happens OUTSIDE it. Routing into a gateway (rank 440) from under the
+fleet lock is uphill and safe, but we don't do it anyway.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
+from kubeflow_rm_tpu.controlplane import metrics as cp_metrics
+from kubeflow_rm_tpu.controlplane.shard.ring import HashRing
+from kubeflow_rm_tpu.controlplane.webapps.serving import (
+    ReplicaUnavailable,
+    ServingGateway,
+)
+
+READY, DRAINING, DEAD = "ready", "draining", "dead"
+
+
+class NoReadyReplica(Exception):
+    """Every replica is draining or dead — the fleet cannot admit."""
+
+
+class ServingFleet:
+    """Affinity router + migration loop over named gateways."""
+
+    def __init__(self, gateways: dict[str, ServingGateway], *,
+                 prefix_tokens: int | None = None, spill_depth: int = 8,
+                 vnodes: int = 16):
+        if not gateways:
+            raise ValueError("fleet needs at least one replica")
+        self.gateways = dict(gateways)
+        if prefix_tokens is None:
+            eng = next(iter(self.gateways.values())).engine
+            prefix_tokens = getattr(eng, "block_size", None) or 16
+        self.prefix_tokens = int(prefix_tokens)
+        self.spill_depth = spill_depth
+        self._vnodes = vnodes
+        self._lock = make_lock("serving.fleet")
+        self._state = {name: READY for name in self.gateways}
+        self._ring = HashRing(sorted(self.gateways), vnodes=vnodes)
+        self.migrations = 0
+        self.spills = 0
+        self._publish_states()
+
+    # -- membership / state ------------------------------------------------
+
+    def _publish_states(self) -> None:
+        counts = {READY: 0, DRAINING: 0, DEAD: 0}
+        for s in self._state.values():
+            counts[s] += 1
+        for s, n in counts.items():
+            cp_metrics.SERVING_FLEET_REPLICAS.labels(s).set(n)
+
+    def _set_state(self, name: str, state: str) -> None:
+        with self._lock:
+            self._state[name] = state
+            ready = [m for m in self.gateways
+                     if self._state[m] == READY]
+            self._ring = (HashRing(ready, vnodes=self._vnodes)
+                          if ready else None)
+            self._publish_states()
+
+    def drain(self, name: str) -> None:
+        """Pull ``name`` out of rotation: ring drops it, its healthz
+        flips 503, its queued requests migrate, its active slots
+        finish. (Kubernetes analogue: preStop hook before SIGTERM.)"""
+        self._set_state(name, DRAINING)
+        self.gateways[name].start_drain()
+
+    def kill(self, name: str) -> None:
+        """Hard-kill ``name`` (chaos arm): every in-flight request —
+        queued AND mid-decode — migrates to another replica."""
+        self._set_state(name, DEAD)
+        self.gateways[name].close()
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._state)
+
+    # -- routing -----------------------------------------------------------
+
+    def affinity_key(self, prompt: list[int],
+                     session: str | None = None) -> str:
+        if session:
+            return f"s:{session}"
+        head = prompt[: self.prefix_tokens]
+        return "p:" + hashlib.md5(
+            b",".join(str(t).encode() for t in head)).hexdigest()
+
+    def route(self, prompt: list[int], session: str | None = None,
+              *, exclude: set[str] | None = None) -> str:
+        """Pick the replica for this request. Raises
+        ``NoReadyReplica`` when nothing can take it."""
+        key = self.affinity_key(prompt, session)
+        with self._lock:
+            ready = [m for m in sorted(self.gateways)
+                     if self._state[m] == READY
+                     and m not in (exclude or ())]
+            if not ready:
+                raise NoReadyReplica("no ready serving replica")
+            ring = (self._ring if not exclude and self._ring is not None
+                    else HashRing(ready, vnodes=self._vnodes))
+            owner = ring.shard_for(key)
+        depth = self.gateways[owner].engine.queue_depth
+        if depth >= self.spill_depth and len(ready) > 1:
+            shallowest = min(
+                ready, key=lambda m: self.gateways[m].engine.queue_depth)
+            if (self.gateways[shallowest].engine.queue_depth < depth
+                    and shallowest != owner):
+                self.spills += 1
+                return shallowest
+        return owner
+
+    # -- request lifecycle -------------------------------------------------
+
+    def submit_and_wait(self, tenant: str, prompt: list[int], *,
+                        max_new_tokens: int, eos_id: int | None = None,
+                        slo_class: str | None = None,
+                        session: str | None = None,
+                        timeout_s: float = 300.0):
+        """Route, decode, and — if the replica goes away mid-flight —
+        migrate and resume. Returns ``(tokens, info)`` on success or
+        ``(None, info)`` on shed; ``info`` carries the replica path and
+        shed reason. A migrated request resumes from the tokens it
+        already produced (greedy continuation is bit-identical to an
+        uninterrupted run), so a kill costs latency, never correctness.
+        """
+        tokens: list[int] = []
+        path: list[str] = []
+        tried: set[str] = set()
+        while True:
+            budget = max_new_tokens - len(tokens)
+            if budget <= 0:
+                return tokens, {"replicas": path, "migrations":
+                                len(path) - 1}
+            try:
+                name = self.route(prompt + tokens, session,
+                                  exclude=tried or None)
+            except NoReadyReplica:
+                return None, {"replicas": path, "reason": "no_replica"}
+            gw = self.gateways[name]
+            try:
+                pending, reason = gw.try_submit(
+                    tenant, prompt + tokens, max_new_tokens=budget,
+                    eos_id=eos_id, slo_class=slo_class)
+            except ValueError:
+                # a resume prompt can overflow slot_len even though the
+                # original request fit: bucket(Tp + tokens_so_far) may
+                # round up to the next power of two while the remaining
+                # budget shrinks by less.  Greedy decode is
+                # deterministic, so restarting from the original prompt
+                # reproduces the same tokens — pay the decode again
+                # rather than fail the request.
+                if not tokens:
+                    raise
+                tokens = []
+                continue
+            if pending is None:
+                if reason in ("rate", "tokens"):
+                    # per-tenant budgets are fleet policy, not replica
+                    # pressure — spilling would launder the quota
+                    return None, {"replicas": path, "reason": reason}
+                tried.add(name)     # queue/slo/draining: try elsewhere
+                continue
+            path.append(name)
+            try:
+                got = gw.wait(pending, timeout_s)
+                tokens.extend(got)
+                return tokens, {"replicas": path,
+                                "migrations": len(path) - 1}
+            except ReplicaUnavailable as e:
+                tokens.extend(e.tokens_so_far)
+                self.migrations += 1
+                cp_metrics.SERVING_MIGRATIONS_TOTAL.inc()
+                tried.add(name)
+                # eos may have landed just before the drain severed us
+                if eos_id is not None and tokens and tokens[-1] == eos_id:
+                    return tokens, {"replicas": path,
+                                    "migrations": len(path) - 1}
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        states = self.states()
+        return {
+            "replicas": {
+                name: {
+                    "state": states[name],
+                    "queue_depth": gw.engine.queue_depth,
+                    "active_slots": gw.engine.active_slots,
+                    "prefix_hit_ratio": gw.engine.stats().get(
+                        "prefix_hit_ratio"),
+                }
+                for name, gw in sorted(self.gateways.items())
+            },
+            "migrations": self.migrations,
+            "spills": self.spills,
+            "prefix_tokens": self.prefix_tokens,
+        }
+
+    def close(self) -> None:
+        for name, gw in self.gateways.items():
+            if self._state[name] != DEAD:
+                gw.close()
+
+
+def make_fleet_app(fleet: ServingFleet, cfg):
+    """werkzeug WSGI front door over the whole fleet: the thing an
+    external LB points at. ``POST /generate`` adds optional
+    ``session`` (stickiness) and ``slo_class`` fields to the
+    single-replica contract; ``GET /api/fleet`` is the ops view;
+    ``POST /replicas/<name>/drain`` is the preStop hook."""
+    from werkzeug.exceptions import BadRequest, HTTPException, NotFound
+    from werkzeug.routing import Map, Rule
+    from werkzeug.wrappers import Request, Response
+
+    urls = Map([
+        Rule("/generate", endpoint="generate", methods=["POST"]),
+        Rule("/healthz", endpoint="healthz"),
+        Rule("/api/fleet", endpoint="fleet"),
+        Rule("/metrics", endpoint="metrics"),
+        Rule("/replicas/<name>/drain", endpoint="drain",
+             methods=["POST"]),
+    ])
+
+    def _json(payload, status=200):
+        return Response(json.dumps(payload), status=status,
+                        content_type="application/json")
+
+    def app(environ, start_response):
+        req = Request(environ)
+        try:
+            endpoint, args = urls.bind_to_environ(environ).match()
+            if endpoint == "healthz":
+                states = fleet.states()
+                ready = sum(1 for s in states.values() if s == READY)
+                status = 200 if ready else 503
+                return _json({"ok": bool(ready), "ready": ready,
+                              "replicas": states}, status)(
+                    environ, start_response)
+            if endpoint == "fleet":
+                return _json(fleet.snapshot())(environ, start_response)
+            if endpoint == "metrics":
+                resp = Response(cp_metrics.scrape(),
+                                content_type="text/plain; version=0.0.4")
+                return resp(environ, start_response)
+            if endpoint == "drain":
+                if args["name"] not in fleet.gateways:
+                    raise NotFound(f"no replica {args['name']}")
+                fleet.drain(args["name"])
+                return _json({"draining": args["name"]})(
+                    environ, start_response)
+            body = req.get_json(force=True)
+            if not isinstance(body, dict):
+                raise BadRequest("body must be a JSON object")
+            prompt = body.get("prompt")
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int)
+                               and 0 <= t < cfg.vocab_size
+                               for t in prompt)):
+                raise BadRequest("prompt must be a non-empty list of "
+                                 f"token ids in [0, {cfg.vocab_size})")
+            tenant = body.get("tenant") \
+                or req.headers.get("X-Tenant") or "default"
+            max_new = body.get("max_new_tokens", 16)
+            if not isinstance(max_new, int) or not 1 <= max_new <= 4096:
+                raise BadRequest("max_new_tokens must be an int in "
+                                 "[1, 4096]")
+            session = body.get("session")
+            if session is not None and (not isinstance(session, str)
+                                        or len(session) > 128):
+                raise BadRequest("session must be a short string")
+            slo_class = body.get("slo_class")
+            if slo_class is not None and slo_class not in (
+                    "interactive", "batch", "best_effort"):
+                raise BadRequest("slo_class must be one of "
+                                 "interactive|batch|best_effort")
+            try:
+                tokens, info = fleet.submit_and_wait(
+                    tenant, prompt, max_new_tokens=max_new,
+                    eos_id=body.get("eos_id"), slo_class=slo_class,
+                    session=session)
+            except ValueError as e:
+                raise BadRequest(str(e)) from e
+            if tokens is None:
+                reason = info.get("reason")
+                status = 429 if reason in ("rate", "tokens") else 503
+                resp = _json({"error": "shed", "reason": reason},
+                             status=status)
+                resp.headers["Retry-After"] = "1"
+            else:
+                resp = _json({"tokens": tokens, **info})
+        except HTTPException as e:
+            resp = e
+        return resp(environ, start_response)
+
+    app.fleet = fleet
+    return app

@@ -248,6 +248,24 @@ def _controlplane_section(api=None) -> dict:
                 "seconds": cp_metrics.registry_value(
                     "serving_request_latency_seconds_sum"),
             },
+            # paged-KV fleet (r13): per-class backlog, shared-prefix
+            # cache effectiveness, block headroom, replica states
+            "class_queue_depth": {
+                c: cp_metrics.registry_value(
+                    "serving_class_queue_depth", {"slo_class": c})
+                for c in ("interactive", "batch", "best_effort")
+            },
+            "prefix_hit_ratio": cp_metrics.registry_value(
+                "serving_prefix_hit_ratio"),
+            "free_block_fraction": cp_metrics.registry_value(
+                "serving_free_block_fraction"),
+            "migrations": cp_metrics.registry_value(
+                "serving_migrations_total"),
+            "fleet_replicas": {
+                s: cp_metrics.registry_value(
+                    "serving_fleet_replicas", {"state": s})
+                for s in ("ready", "draining", "dead")
+            },
         },
         # error accounting: intentionally-absorbed exceptions (KFRM005
         # counts them instead of letting them vanish); per-module split

@@ -56,12 +56,26 @@ from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 class TenantPolicy:
     """Per-tenant admission knobs. ``qps``/``burst`` bound request
     RATE; ``tokens_per_s``/``token_burst`` bound decoded-token SPEND;
-    ``slo_p95_ms`` is the latency promise the gateway sheds to keep."""
+    ``slo_p95_ms`` is the latency promise the gateway sheds to keep;
+    ``slo_class`` is the engine queue the tenant's admitted requests
+    drain from (interactive | batch | best_effort)."""
     qps: float = 20.0
     burst: int = 40
     tokens_per_s: float = 2000.0
     token_burst: int = 4000
     slo_p95_ms: float = 2000.0
+    slo_class: str = "interactive"
+
+
+class ReplicaUnavailable(Exception):
+    """The replica gave this request up before finishing it (drain or
+    death). The request is NOT failed — the caller (serving fleet, or
+    any retrying client) resubmits it elsewhere and the generation
+    resumes from the tokens already produced."""
+
+    def __init__(self, msg: str, tokens_so_far=None):
+        super().__init__(msg)
+        self.tokens_so_far = list(tokens_so_far or [])
 
 
 class _Pending:
@@ -69,7 +83,7 @@ class _Pending:
     the drain thread decodes."""
 
     __slots__ = ("req", "tenant", "event", "t_submit", "t_done",
-                 "trace", "t_submit_epoch")
+                 "trace", "t_submit_epoch", "failed")
 
     def __init__(self, req, tenant, trace=None):
         self.req = req
@@ -77,6 +91,10 @@ class _Pending:
         self.event = threading.Event()
         self.t_submit = time.monotonic()
         self.t_done = None
+        # set when the replica abandons the request (drain/close)
+        # before the engine finishes it — wait() then raises
+        # ReplicaUnavailable instead of returning a torn result
+        self.failed = False
         # traceparent of the admitting request, if it carried one —
         # the drain thread stamps the decode span against it; epoch
         # twin of t_submit because spans use wall time
@@ -116,6 +134,7 @@ class ServingGateway:
         self._exemplars: dict[str, dict] = {}
         self._ema_ms: float | None = None
         self.shed_counts: dict[str, int] = {}
+        self.draining = False
         self._stop = threading.Event()
         cp_metrics.SERVING_SLOT_CAPACITY.set(engine.slots)
         self._thread = threading.Thread(target=self._drain, daemon=True)
@@ -143,15 +162,21 @@ class ServingGateway:
         self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
 
     def try_submit(self, tenant: str, prompt: list[int], *,
-                   max_new_tokens: int,
-                   eos_id: int | None = None) -> tuple[_Pending | None,
-                                                       str | None]:
+                   max_new_tokens: int, eos_id: int | None = None,
+                   slo_class: str | None = None
+                   ) -> tuple[_Pending | None, str | None]:
         """Admit or shed. Returns (pending, None) on admit,
-        (None, reason) on shed — reason in rate|tokens|queue|slo."""
+        (None, reason) on shed — reason in
+        rate|tokens|queue|slo|draining. ``slo_class`` overrides the
+        tenant policy's default engine queue."""
         pol = self._policy(tenant)
         trace = tracing.current_traceparent()
         with tracing.start_span_if_active(
                 "serving.admit", attrs={"tenant": tenant}) as sp:
+            if self.draining:
+                self._shed(tenant, "draining")
+                sp.set_attr("shed", "draining")
+                return None, "draining"
             if self.admission:
                 rate, budget = self._buckets(tenant)
                 if not rate.try_acquire(1.0):
@@ -163,6 +188,14 @@ class ServingGateway:
                     sp.set_attr("shed", "tokens")
                     return None, "tokens"
             with self._lock:
+                # re-check under the lock: a drain/close that began
+                # after the fast-path check above must not let this
+                # request enqueue onto a stopping replica (it would
+                # never be drained OR failed — a silent hang)
+                if self.draining:
+                    self._shed(tenant, "draining")
+                    sp.set_attr("shed", "draining")
+                    return None, "draining"
                 depth = self.engine.queue_depth
                 if depth >= self.max_queue:
                     self._shed(tenant, "queue")
@@ -175,9 +208,10 @@ class ServingGateway:
                         self._shed(tenant, "slo")
                         sp.set_attr("shed", "slo")
                         return None, "slo"
-                req = self.engine.submit(prompt,
-                                         max_new_tokens=max_new_tokens,
-                                         eos_id=eos_id)
+                req = self.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    eos_id=eos_id,
+                    slo_class=slo_class or pol.slo_class)
                 pending = _Pending(req, tenant, trace=trace)
                 self._pending.append(pending)
                 cp_metrics.SERVING_QUEUE_DEPTH.set(
@@ -188,6 +222,11 @@ class ServingGateway:
              ) -> list[int]:
         if not pending.event.wait(timeout_s):
             raise TimeoutError("generation timed out")
+        if pending.failed and not pending.req.done:
+            raise ReplicaUnavailable(
+                "replica gave up this request mid-flight "
+                "(drain or shutdown) — resubmit elsewhere",
+                tokens_so_far=pending.req.tokens)
         lat_s = pending.t_done - pending.t_submit
         tenant = pending.tenant
         cp_metrics.SERVING_REQUESTS_TOTAL.labels(tenant, "ok").inc()
@@ -213,6 +252,18 @@ class ServingGateway:
                         stats["active_slots"])
                     cp_metrics.SERVING_BATCH_OCCUPANCY.set(
                         stats["batch_occupancy"])
+                    for c, d in stats.get("queue_depth_by_class",
+                                          {}).items():
+                        cp_metrics.SERVING_CLASS_QUEUE_DEPTH.labels(
+                            c).set(d)
+                    if stats.get("paged"):
+                        cp_metrics.SERVING_FREE_BLOCK_FRACTION.set(
+                            stats["free_block_fraction"])
+                        if stats.get("prompt_tokens"):
+                            hr = stats["prefix_hit_ratio"]
+                            cp_metrics.SERVING_PREFIX_HIT_RATIO.set(hr)
+                            cp_metrics.SERVING_PREFIX_MISS_RATIO.set(
+                                1.0 - hr)
                 if finished:
                     done_ids = {id(p.req) for p in self._pending
                                 if p.req.done}
@@ -252,11 +303,39 @@ class ServingGateway:
             if not busy:
                 self._stop.wait(0.001)
 
+    def start_drain(self) -> list[_Pending]:
+        """Begin pulling this replica out of rotation: new submits
+        shed with reason ``draining`` (healthz flips 503 so LBs stop
+        routing here), QUEUED requests are evicted and handed back to
+        the caller for re-routing (their ``wait`` raises
+        ``ReplicaUnavailable``), and requests already holding a decode
+        slot finish normally. Returns the evicted pendings."""
+        with self._lock:
+            self.draining = True
+            evicted_reqs = {id(r) for r in self.engine.evict_queued()}
+            evicted = [p for p in self._pending
+                       if id(p.req) in evicted_reqs]
+            self._pending = [p for p in self._pending
+                             if id(p.req) not in evicted_reqs]
+            cp_metrics.SERVING_QUEUE_DEPTH.set(self.engine.queue_depth)
+        for p in evicted:
+            p.failed = True
+            p.t_done = time.monotonic()
+            p.event.set()
+        return evicted
+
     def close(self) -> None:
+        with self._lock:
+            # flip draining first so a submit racing with close sheds
+            # instead of enqueueing onto the stopped drain thread
+            self.draining = True
+            orphans = list(self._pending)
+            self._pending = []
         self._stop.set()
         self._thread.join(timeout=5)
-        for p in self._pending:   # fail any orphans
-            p.t_done = time.monotonic()
+        for p in orphans:         # fail any orphans; a request the
+            p.failed = True       # engine DID finish stays ok (wait
+            p.t_done = time.monotonic()   # checks req.done first)
             p.event.set()
 
     # -- observability -----------------------------------------------------
@@ -279,6 +358,12 @@ class ServingGateway:
         stats = self.engine.stats()
         return {
             "admission": self.admission,
+            "draining": self.draining,
+            "paged": stats.get("paged", False),
+            "queue_depth_by_class": stats.get("queue_depth_by_class"),
+            "prefix_hit_ratio": stats.get("prefix_hit_ratio"),
+            "free_block_fraction": stats.get("free_block_fraction"),
+            "cow_forks": stats.get("cow_forks"),
             "queue_depth": stats["queue_depth"],
             "active_slots": stats["active_slots"],
             "slot_capacity": stats["slots"],
@@ -334,7 +419,14 @@ def make_serving_app(gateway: ServingGateway, cfg):
         try:
             endpoint, _ = urls.bind_to_environ(environ).match()
             if endpoint == "healthz":
-                return _json({"ok": True})(environ, start_response)
+                # a draining replica must fail its health check BEFORE
+                # its queue is severed, so routers/LBs stop sending new
+                # work while in-flight requests still finish here
+                if gateway.draining:
+                    return _json({"ok": False, "state": "draining"},
+                                 status=503)(environ, start_response)
+                return _json({"ok": True, "state": "ready"})(
+                    environ, start_response)
             if endpoint == "metrics":
                 resp = Response(cp_metrics.scrape(),
                                 content_type="text/plain; version=0.0.4")
@@ -363,10 +455,15 @@ def make_serving_app(gateway: ServingGateway, cfg):
             eos_id = body.get("eos_id")
             if eos_id is not None and not isinstance(eos_id, int):
                 raise BadRequest("eos_id must be an int")
+            slo_class = body.get("slo_class")
+            if slo_class is not None and slo_class not in (
+                    "interactive", "batch", "best_effort"):
+                raise BadRequest("slo_class must be one of "
+                                 "interactive|batch|best_effort")
             try:
                 pending, reason = gateway.try_submit(
                     tenant, prompt, max_new_tokens=max_new,
-                    eos_id=eos_id)
+                    eos_id=eos_id, slo_class=slo_class)
             except ValueError as e:   # request cannot fit a slot
                 raise BadRequest(str(e)) from e
             if pending is None:

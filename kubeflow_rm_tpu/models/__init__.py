@@ -29,6 +29,10 @@ from kubeflow_rm_tpu.models.generate import (
     make_generate_step,
     slot_decode_step,
 )
+from kubeflow_rm_tpu.models.generate import (
+    DEFAULT_CLASS_WEIGHTS,
+    SLO_CLASSES,
+)
 from kubeflow_rm_tpu.models.llama import LlamaConfig, forward
 from kubeflow_rm_tpu.models.mixtral import MixtralConfig
 
@@ -50,7 +54,20 @@ def forward_with_aux(params, tokens, cfg: LlamaConfig, **kwargs):
     return _llama.forward(params, tokens, cfg, **kwargs), None
 
 
-__all__ = ["ContinuousBatchingEngine", "EngineRequest", "KVCache",
+from kubeflow_rm_tpu.models.paging import (
+    BlockPool,
+    PagedKVCache,
+    init_paged_cache,
+    paged_decode_step,
+    paged_prefill,
+    prefix_keys,
+)
+
+__all__ = ["BlockPool", "ContinuousBatchingEngine",
+           "DEFAULT_CLASS_WEIGHTS", "EngineRequest", "KVCache",
+           "PagedKVCache", "SLO_CLASSES",
+           "init_paged_cache", "paged_decode_step", "paged_prefill",
+           "prefix_keys",
            "LlamaConfig", "MixtralConfig", "SlotCache", "add_lora",
            "config_from_hf",
            "cache_shardings", "decode_chunk", "forward", "forward_with_aux", "from_hf_llama",

@@ -725,6 +725,12 @@ def _bucket_len(n: int) -> int:
     return b
 
 
+#: in-engine SLO classes, drained by weighted share at token
+#: boundaries (replacing the single FIFO between gateway and engine)
+SLO_CLASSES = ("interactive", "batch", "best_effort")
+DEFAULT_CLASS_WEIGHTS = {"interactive": 8, "batch": 3, "best_effort": 1}
+
+
 class EngineRequest:
     """Handle returned by ``ContinuousBatchingEngine.submit``:
     ``tokens`` fills in as the request decodes; ``done`` flips when the
@@ -733,13 +739,14 @@ class EngineRequest:
     _next_id = 0
 
     def __init__(self, prompt, *, max_new_tokens, eos_id, temperature,
-                 top_k, key):
+                 top_k, key, slo_class="interactive"):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.temperature = float(temperature)
         self.top_k = top_k
         self.key = key
+        self.slo_class = slo_class
         self.tokens: list[int] = []
         self.done = False
         self.rid = EngineRequest._next_id
@@ -753,87 +760,281 @@ class EngineRequest:
 class ContinuousBatchingEngine:
     """Slot-based continuous-batching decode engine.
 
-    ``submit`` queues a request; ``step`` admits queued requests into
-    free slots (one prefill each, via the shared ``_decode_step``),
-    runs ONE ``slot_decode_step`` for all live slots, samples each
-    slot's next token host-side, and retires slots that hit eos or
-    their token budget — so short requests leave (and new ones enter)
-    mid-flight instead of waiting for the longest neighbour.
+    ``submit`` queues a request into its SLO class; ``step`` admits
+    queued requests into free slots (one prefill each), runs ONE
+    decode step for all live slots, samples each slot's next token
+    host-side, and retires slots that hit eos or their token budget —
+    so short requests leave (and new ones enter) mid-flight instead of
+    waiting for the longest neighbour.
 
-    Exactness contract: each request's output is bit-identical to
-    ``generate_fused(prompt[None], max_new_tokens=..., max_len=slot_len)``
-    for that request alone (greedy; sampled requests use their own key
-    stream). Packed-int4 params are unpacked ONCE at construction so
-    per-step cost is the int8→bf16 dequant prologue, same as the fixed
-    fused path.
+    Two cache arms:
+
+    - ``paged=True`` (default): KV lives in a block pool
+      (``models.paging``) with per-slot block tables, refcounted
+      copy-on-write prefix sharing (a shared system prompt is
+      prefilled once, later requests adopt the cached blocks), and
+      LRU retention of retired prefix blocks.
+    - ``paged=False``: the r12 contiguous ``SlotCache`` — kept as the
+      measured A/B baseline arm (``benchmarks/serve_bench.py``).
+
+    Admission drains three priority-weighted class queues
+    (``SLO_CLASSES``) by smooth weighted round-robin at token
+    boundaries — interactive requests keep jumping a best-effort
+    backlog without starving it.
+
+    Exactness contract (both arms): each request's output is
+    bit-identical to ``generate_fused(prompt[None],
+    max_new_tokens=..., max_len=slot_len)`` for that request alone
+    (greedy; sampled requests use their own key stream) — cached
+    prefix or not. Packed-int4 params are unpacked ONCE at
+    construction so per-step cost is the int8→bf16 dequant prologue,
+    same as the fixed fused path.
     """
 
     def __init__(self, params, cfg, *, slots: int = 8,
-                 slot_len: int = 256):
+                 slot_len: int = 256, paged: bool = True,
+                 block_size: int = 16, num_blocks: int | None = None,
+                 class_weights: dict | None = None,
+                 prefix_cache: bool = True):
+        from kubeflow_rm_tpu.models import paging
+
         self.cfg = cfg
         self.slots = slots
         self.slot_len = slot_len
+        self.paged = paged
         # unpack int4 leaves once, outside any per-step work; no-op on
         # int8/bf16 trees
         self.params = jax.jit(unpack_int4_params)(params)
-        self.cache = init_slot_cache(cfg, slots, slot_len)
+        if paged:
+            if slot_len % block_size:
+                raise ValueError(
+                    f"slot_len {slot_len} must be a multiple of "
+                    f"block_size {block_size}")
+            self.block_size = block_size
+            maxb = slot_len // block_size
+            if num_blocks is None:
+                # every slot fully packed + 50% headroom so retired
+                # prefix blocks can be RETAINED instead of recycled
+                num_blocks = (paging.RESERVED_BLOCKS + slots * maxb
+                              + max(maxb, (slots * maxb) // 2))
+            self.pool = paging.BlockPool(num_blocks, block_size)
+            self.prefix_cache = prefix_cache
+            self.cache = paging.init_paged_cache(
+                cfg, slots, slot_len, num_blocks, block_size)
+        else:
+            self.block_size = None
+            self.pool = None
+            self.prefix_cache = False
+            self.cache = init_slot_cache(cfg, slots, slot_len)
         self._slot_req: list[EngineRequest | None] = [None] * slots
+        self._slot_blocks: list[list | None] = [None] * slots
         self._last = [None] * slots   # (V,) logits per live slot
-        self._queue: list[EngineRequest] = []
+        self._queues = {c: [] for c in SLO_CLASSES}
+        self.class_weights = dict(DEFAULT_CLASS_WEIGHTS)
+        if class_weights:
+            self.class_weights.update(class_weights)
+        self._credits = {c: 0.0 for c in SLO_CLASSES}
         # counters surfaced by stats()
         self.decode_steps = 0
         self.prefills = 0
         self.occupancy_sum = 0
         self.admitted_total = 0
         self.finished_total = 0
+        self.admitted_by_class = {c: 0 for c in SLO_CLASSES}
+        self.prefix_hit_tokens = 0
+        self.prompt_tokens = 0
 
     # -- request lifecycle -------------------------------------------------
 
     def submit(self, prompt, *, max_new_tokens: int,
                eos_id: int | None = None, temperature: float = 0.0,
                top_k: int | None = None,
-               key: jax.Array | None = None) -> EngineRequest:
+               key: jax.Array | None = None,
+               slo_class: str = "interactive") -> EngineRequest:
         Tp = len(prompt)
         if Tp == 0:
             raise ValueError("empty prompt")
+        if slo_class not in SLO_CLASSES:
+            raise ValueError(f"unknown slo_class {slo_class!r} "
+                             f"(one of {SLO_CLASSES})")
         need = _bucket_len(Tp) + max_new_tokens
         if need > self.slot_len:
             raise ValueError(
                 f"request needs {need} cache slots (prefill bucket "
                 f"{_bucket_len(Tp)} + {max_new_tokens} new) > slot_len "
                 f"{self.slot_len}")
+        if self.paged:
+            chunks = -(-(Tp + max_new_tokens) // self.block_size)
+            if chunks > self.pool.usable_blocks:
+                raise ValueError(
+                    f"request needs {chunks} KV blocks > pool of "
+                    f"{self.pool.usable_blocks} usable blocks")
         if temperature > 0 and key is None:
             raise ValueError("sampling (temperature > 0) requires a key")
         req = EngineRequest(prompt, max_new_tokens=max_new_tokens,
                             eos_id=eos_id, temperature=temperature,
-                            top_k=top_k, key=key)
+                            top_k=top_k, key=key, slo_class=slo_class)
         req.submitted_step = self.decode_steps
-        self._queue.append(req)
+        self._queues[slo_class].append(req)
         return req
+
+    def _next_queued(self) -> EngineRequest | None:
+        """Smooth weighted round-robin over the non-empty class
+        queues: every pick tops each contender up by its weight, the
+        highest credit wins and pays back the round's total — over
+        time each class's share of admissions converges to its weight
+        share, and no non-empty class starves."""
+        live = [c for c in SLO_CLASSES if self._queues[c]]
+        if not live:
+            return None
+        total = sum(self.class_weights[c] for c in live)
+        for c in live:
+            self._credits[c] += self.class_weights[c]
+        chosen = max(live, key=lambda c: (self._credits[c],
+                                          -SLO_CLASSES.index(c)))
+        self._credits[chosen] -= total
+        return self._queues[chosen].pop(0)
+
+    def _requeue_front(self, req: EngineRequest) -> None:
+        self._queues[req.slo_class].insert(0, req)
+
+    def evict_queued(self) -> list[EngineRequest]:
+        """Pull every not-yet-admitted request back out (drain path:
+        the gateway re-routes them to another replica). Admitted
+        slots are untouched — they finish here."""
+        out: list[EngineRequest] = []
+        for c in SLO_CLASSES:
+            out.extend(self._queues[c])
+            self._queues[c] = []
+        return out
 
     def _admit(self) -> None:
         for i in range(self.slots):
-            if not self._queue:
-                return
             if self._slot_req[i] is not None:
                 continue
-            req = self._queue.pop(0)
-            Tp = len(req.prompt)
-            Tb = _bucket_len(Tp)
-            padded = jnp.asarray([[0] * (Tb - Tp) + req.prompt],
-                                 jnp.int32)
-            pads = jnp.asarray([Tb - Tp], jnp.int32)
-            tmp = init_cache(self.cfg, 1, self.slot_len)
-            logits, tmp = _decode_step(self.params, self.cfg, tmp,
-                                       padded, pads)
-            self.cache = _install_row(
-                self.cache, tmp, jnp.asarray(i, jnp.int32),
-                jnp.asarray(Tp, jnp.int32))
-            self._last[i] = logits[0, -1, :]
+            req = self._next_queued()
+            if req is None:
+                return
+            if self.paged:
+                last = self._admit_paged(i, req)
+                if last is None:
+                    # transient block OOM: head waits at the front of
+                    # its class queue; blocks free as slots retire (or
+                    # as retained prefix blocks get evicted), so this
+                    # always makes progress eventually
+                    self._requeue_front(req)
+                    return
+            else:
+                last = self._admit_contiguous(i, req)
+            self._last[i] = last
             self._slot_req[i] = req
             req.admitted_step = self.decode_steps
             self.prefills += 1
             self.admitted_total += 1
+            self.admitted_by_class[req.slo_class] += 1
+
+    def _admit_contiguous(self, i: int, req: EngineRequest):
+        Tp = len(req.prompt)
+        Tb = _bucket_len(Tp)
+        padded = jnp.asarray([[0] * (Tb - Tp) + req.prompt], jnp.int32)
+        pads = jnp.asarray([Tb - Tp], jnp.int32)
+        tmp = init_cache(self.cfg, 1, self.slot_len)
+        logits, tmp = _decode_step(self.params, self.cfg, tmp,
+                                   padded, pads)
+        self.cache = _install_row(
+            self.cache, tmp, jnp.asarray(i, jnp.int32),
+            jnp.asarray(Tp, jnp.int32))
+        return logits[0, -1, :]
+
+    def _admit_paged(self, i: int, req: EngineRequest):
+        """Plan blocks, prefill the un-cached suffix, install. Returns
+        the last real token's logits row, or ``None`` on transient
+        block OOM (pool state untouched — clean rejection).
+
+        Plan: the longest consecutive cached chain covers ``n_hit``
+        prompt tokens (clamped to Tp-1: the last prompt token is
+        always prefilled, its logits seed sampling). Chunks fully
+        inside the hit are ADOPTED (incref, never written); the chunk
+        containing ``n_hit`` — when mid-block — is FORKED: the request
+        gets its own copy, because its own writes (suffix prefill +
+        generated tokens from offset Tp) land there. That fork is the
+        copy-on-write: shared blocks are immutable, first write forks.
+        """
+        from kubeflow_rm_tpu.models import paging
+
+        pool, BS = self.pool, self.block_size
+        maxb = self.slot_len // BS
+        Tp, budget = len(req.prompt), req.max_new_tokens
+        keys = (paging.prefix_keys(req.prompt, BS)
+                if self.prefix_cache else [])
+        chain = pool.lookup_chain(keys)
+        n_hit = min(keys[len(chain) - 1][0] if chain else 0, Tp - 1)
+        # fit: cached tokens + the suffix's padding bucket must fit
+        # the strip; dropping back to a block boundary only costs
+        # re-prefill of the dropped tokens
+        while n_hit > 0 and n_hit + _bucket_len(Tp - n_hit) > self.slot_len:
+            n_hit = ((n_hit - 1) // BS) * BS
+        shared_full = n_hit // BS
+        fork = n_hit % BS != 0
+        shared = chain[:shared_full]
+        needed = -(-(Tp + budget) // BS)
+        owned_n = needed - shared_full
+
+        # pin sources before alloc: alloc may EVICT ref-0 retained
+        # blocks, and evicting a block we are about to read from (or
+        # re-handing it out as our own fresh block) would corrupt the
+        # copy. On OOM the pins roll back — no torn state.
+        pins = chain[:shared_full + 1] if fork else shared
+        pool.incref(pins)
+        fresh = pool.alloc(owned_n)
+        if fresh is None:
+            pool.decref(pins)
+            return None
+        if fork:
+            pool.cow_forks += 1
+
+        load_row = [paging.NULL_BLOCK] * maxb
+        load_row[:len(pins)] = pins
+        final_row = [paging.NULL_BLOCK] * maxb
+        final_row[:shared_full] = shared
+        final_row[shared_full:needed] = fresh
+        # owned chunks land in their blocks; shared chunks and tail
+        # chunks past the allocation divert to SINK (never overwrite a
+        # shared block, never touch NULL)
+        dest_row = [c_blk if shared_full <= c < needed else
+                    paging.SINK_BLOCK
+                    for c, c_blk in enumerate(final_row)]
+
+        suffix = req.prompt[n_hit:]
+        Tc = _bucket_len(len(suffix))
+        padded = jnp.asarray([suffix + [0] * (Tc - len(suffix))],
+                             jnp.int32)
+        last, tk, tv, tpos = paging.paged_prefill(
+            self.params, self.cfg, self.cache,
+            jnp.asarray(load_row, jnp.int32),
+            jnp.asarray(n_hit, jnp.int32), padded,
+            jnp.asarray(len(suffix), jnp.int32))
+        self.cache = paging.paged_install(
+            self.cache, tk, tv, tpos, jnp.asarray(i, jnp.int32),
+            jnp.asarray(final_row, jnp.int32),
+            jnp.asarray(dest_row, jnp.int32),
+            jnp.asarray(Tp, jnp.int32))
+        if fork:
+            pool.decref([chain[shared_full]])   # unpin the fork source
+        if self.prefix_cache:
+            for covered, key in keys:
+                pool.register(key, final_row[(covered - 1) // BS])
+        self._slot_blocks[i] = shared + fresh
+        self.prefix_hit_tokens += n_hit
+        self.prompt_tokens += Tp
+        return last
+
+    def _retire(self, i: int) -> None:
+        if self.paged and self._slot_blocks[i] is not None:
+            self.pool.decref(self._slot_blocks[i])
+        self._slot_blocks[i] = None
+        self._slot_req[i] = None
+        self._last[i] = None
 
     def step(self) -> list[EngineRequest]:
         """Admit, sample, retire, decode — one token boundary. Returns
@@ -858,17 +1059,24 @@ class ContinuousBatchingEngine:
                 req.done = True
                 req.finished_step = self.decode_steps
                 finished.append(req)
-                self._slot_req[i] = None
-                self._last[i] = None
+                self._retire(i)
                 self.finished_total += 1
             else:
                 tokens[i] = nxt
                 active[i] = True
         n_active = sum(active)
         if n_active:
-            last, self.cache = slot_decode_step(
-                self.params, self.cfg, self.cache,
-                jnp.asarray(tokens, jnp.int32), jnp.asarray(active))
+            if self.paged:
+                from kubeflow_rm_tpu.models import paging
+                last, self.cache = paging.paged_decode_step(
+                    self.params, self.cfg, self.cache,
+                    jnp.asarray(tokens, jnp.int32),
+                    jnp.asarray(active))
+            else:
+                last, self.cache = slot_decode_step(
+                    self.params, self.cfg, self.cache,
+                    jnp.asarray(tokens, jnp.int32),
+                    jnp.asarray(active))
             for i in range(self.slots):
                 if active[i]:
                     self._last[i] = last[i]
@@ -879,7 +1087,8 @@ class ContinuousBatchingEngine:
     def run(self) -> list[EngineRequest]:
         """Drive ``step`` until every queued/live request retires."""
         out: list[EngineRequest] = []
-        while self._queue or any(r is not None for r in self._slot_req):
+        while (self.queue_depth
+               or any(r is not None for r in self._slot_req)):
             out.extend(self.step())
         return out
 
@@ -887,7 +1096,11 @@ class ContinuousBatchingEngine:
 
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return sum(len(q) for q in self._queues.values())
+
+    @property
+    def queue_depth_by_class(self) -> dict:
+        return {c: len(self._queues[c]) for c in SLO_CLASSES}
 
     @property
     def active_slots(self) -> int:
@@ -895,15 +1108,26 @@ class ContinuousBatchingEngine:
 
     def stats(self) -> dict:
         steps = self.decode_steps
-        return {
+        out = {
             "slots": self.slots,
             "slot_len": self.slot_len,
+            "paged": self.paged,
             "active_slots": self.active_slots,
             "queue_depth": self.queue_depth,
+            "queue_depth_by_class": self.queue_depth_by_class,
             "decode_steps": steps,
             "prefills": self.prefills,
             "admitted_total": self.admitted_total,
+            "admitted_by_class": dict(self.admitted_by_class),
             "finished_total": self.finished_total,
             "batch_occupancy": (self.occupancy_sum / (steps * self.slots)
                                 if steps else 0.0),
         }
+        if self.paged:
+            out.update(self.pool.stats())
+            out["prefix_hit_tokens"] = self.prefix_hit_tokens
+            out["prompt_tokens"] = self.prompt_tokens
+            out["prefix_hit_ratio"] = (
+                self.prefix_hit_tokens / self.prompt_tokens
+                if self.prompt_tokens else 0.0)
+        return out

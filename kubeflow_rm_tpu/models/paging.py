@@ -1,0 +1,424 @@
+"""Block-paged KV cache with copy-on-write prefix sharing.
+
+The vLLM-shape upgrade to the serving engine (ROADMAP item 2): instead
+of one contiguous ``slot_len`` KV strip per decode slot, the cache is a
+single pool of fixed-size **blocks** (``block_size`` tokens each) and
+every slot owns a **block table** — a row of block indices whose
+concatenation is that slot's logical KV strip. Two consequences:
+
+- **Prefix sharing.** Blocks are content-addressed: a chained
+  token-hash over the prompt (hash of ``tokens[:block_size]``, then
+  ``tokens[:2*block_size]``, ...) keys each *full* block, plus one
+  trailing key for the partial last block. Identical prefixes resolve
+  to the same chain, so an 80%-shared system prompt is prefilled once
+  and later requests just point their tables at the cached blocks
+  (refcounted). Shared blocks are never written — a request that must
+  write into a partially-filled shared block (its first generated
+  token lands mid-block) **forks** it first: copy-on-write at the
+  first write, counted in ``BlockPool.cow_forks``.
+- **Packing.** Slot capacity stops being ``slots x worst-case
+  length``: short requests hold few blocks, retired blocks return to
+  the free pool, and prefix blocks whose refcount hits zero are
+  *retained* in an LRU and only evicted when an allocation needs them.
+
+Exactness contract (the whole point of the design): a slot's gathered
+view — ``pool[k][:, table].reshape(...)`` — is byte-for-byte the
+contiguous cache a solo ``generate_fused(prompt[None],
+max_len=slot_len)`` call would build, because (a) prefill right-pads
+(token *t* sits at offset *t*, preserving block alignment; pad columns
+carry position ``_UNFILLED`` so the causal mask hides them), and
+(b) splitting prefill at a cached-prefix boundary is bit-identical to
+one wide chunk under XLA (verified in ``tests/test_paging.py``). So
+per-request outputs stay bit-identical to solo ``generate_fused``,
+cached prefix or not.
+
+Layout notes (CPU/TPU-portable XLA, no custom kernel): the decode step
+gathers each slot's blocks into a contiguous (B, slot_len) view, runs
+the same ``_run_blocks`` trunk as the contiguous engine, and scatters
+back only the one written column. Two blocks are reserved: block 0 is
+NULL (all-``_UNFILLED`` positions, the gather target of unassigned
+table entries — never written) and block 1 is SINK (the redirect
+target for writes that must go nowhere: inactive rows' decode writes
+and install chunks that belong to shared blocks).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_rm_tpu.models.generate import _UNFILLED, _run_blocks
+from kubeflow_rm_tpu.models.llama import LlamaConfig
+
+#: reserved block ids (see module docstring)
+NULL_BLOCK = 0
+SINK_BLOCK = 1
+RESERVED_BLOCKS = 2
+
+
+# ---------------------------------------------------------------------------
+# device state
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PagedKVCache:
+    """Pool-of-blocks KV state. ``block_tables[i]`` concatenated is
+    slot *i*'s logical strip of ``slot_len = MAXB * BS`` positions;
+    ``write_idx``/``pos_next`` are the same per-slot counters
+    ``SlotCache`` keeps, expressed in logical-strip offsets."""
+    k: jax.Array             # (L, NB, BS, KVH, hd) compute dtype
+    v: jax.Array             # (L, NB, BS, KVH, hd)
+    positions: jax.Array     # (NB, BS) int32; _UNFILLED marks empty
+    block_tables: jax.Array  # (SLOTS, MAXB) int32; NULL_BLOCK = unset
+    write_idx: jax.Array     # (SLOTS,) int32: next logical write slot
+    pos_next: jax.Array      # (SLOTS,) int32: next token position
+
+
+def init_paged_cache(cfg: LlamaConfig, slots: int, slot_len: int,
+                     num_blocks: int, block_size: int) -> PagedKVCache:
+    if slot_len % block_size:
+        raise ValueError(f"slot_len {slot_len} must be a multiple of "
+                         f"block_size {block_size}")
+    if num_blocks <= RESERVED_BLOCKS:
+        raise ValueError(f"num_blocks {num_blocks} leaves no usable "
+                         f"blocks ({RESERVED_BLOCKS} are reserved)")
+    L, KVH, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    maxb = slot_len // block_size
+    return PagedKVCache(
+        k=jnp.zeros((L, num_blocks, block_size, KVH, hd), cfg.dtype),
+        v=jnp.zeros((L, num_blocks, block_size, KVH, hd), cfg.dtype),
+        positions=jnp.full((num_blocks, block_size), _UNFILLED,
+                           jnp.int32),
+        block_tables=jnp.full((slots, maxb), NULL_BLOCK, jnp.int32),
+        write_idx=jnp.zeros((slots,), jnp.int32),
+        pos_next=jnp.zeros((slots,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# jitted ops
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def paged_decode_step(params, cfg, cache: PagedKVCache, tokens, active):
+    """One decode step over every slot, against gathered block views.
+
+    Mirrors ``slot_decode_step`` exactly: each active row attends at
+    its own ``pos_next`` over its gathered (slot_len-long) strip and
+    writes K/V at its own ``write_idx``; inactive rows flow through
+    with query position ``_UNFILLED`` and their (garbage) pool write
+    redirected to SINK_BLOCK — their table may reference blocks that
+    other slots now own, so unlike the contiguous engine their write
+    target is NOT private and must be diverted. Only the one written
+    column per row is scattered back to the pool.
+    """
+    B, MAXB = cache.block_tables.shape
+    BS = cache.positions.shape[1]
+    S = MAXB * BS
+    rows = jnp.arange(B, dtype=jnp.int32)
+
+    positions = jnp.where(active, cache.pos_next, _UNFILLED)[:, None]
+    wi = jnp.clip(cache.write_idx, 0, S - 1)
+    blk = jnp.where(active, cache.block_tables[rows, wi // BS],
+                    SINK_BLOCK)
+    off = wi % BS
+
+    # gathered per-slot contiguous views: bit-identical to the strip a
+    # contiguous SlotCache would hold for the same request
+    gk = cache.k[:, cache.block_tables].reshape(
+        cache.k.shape[0], B, S, *cache.k.shape[3:])
+    gv = cache.v[:, cache.block_tables].reshape(
+        cache.v.shape[0], B, S, *cache.v.shape[3:])
+    gpos = cache.positions[cache.block_tables].reshape(B, S)
+    kv_positions = gpos.at[rows, wi].set(positions[:, 0])
+
+    def write_kv(c, val):
+        return c.at[rows, wi].set(val[:, 0])
+
+    logits, new_k, new_v = _run_blocks(
+        params, cfg, gk, gv, tokens[:, None], positions, kv_positions,
+        write_kv)
+
+    # scatter ONLY the written column back to the pool (inactive rows
+    # land in SINK); duplicate sink hits are garbage-on-garbage
+    col_k = new_k[:, rows, wi]          # (L, B, KVH, hd)
+    col_v = new_v[:, rows, wi]
+    inc = active.astype(jnp.int32)
+    new_cache = PagedKVCache(
+        k=cache.k.at[:, blk, off].set(col_k),
+        v=cache.v.at[:, blk, off].set(col_v),
+        positions=cache.positions.at[blk, off].set(
+            jnp.where(active, cache.pos_next, _UNFILLED)),
+        block_tables=cache.block_tables,
+        write_idx=cache.write_idx + inc,
+        pos_next=cache.pos_next + inc,
+    )
+    return logits[:, -1, :], new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def paged_prefill(params, cfg, cache: PagedKVCache, load_row, n_hit,
+                  tokens, n_real):
+    """Prefill one request's suffix against its cached prefix.
+
+    ``load_row`` (MAXB,) names the SOURCE blocks of the shared prefix
+    (chunks beyond it are NULL); the gathered strip is truncated to
+    ``n_hit`` tokens (everything at/after ``n_hit`` reads
+    ``_UNFILLED`` — a partially-reused source block may carry another
+    request's live tokens past the shared region, and truncation is
+    what makes borrowing it safe). ``tokens`` (1, Tc) is the
+    right-pad-bucketed suffix whose first ``n_real`` columns are real;
+    it runs at offsets ``n_hit .. n_hit+Tc``. Returns the last REAL
+    token's logits plus the full temp strip (k, v, positions) for
+    ``paged_install`` to carve into blocks.
+
+    Split-at-``n_hit`` prefill is bit-identical to one full-width
+    chunk, and right-pad columns (position ``_UNFILLED``) leave real
+    columns bit-identical — both properties are what lets a cached
+    prefix + suffix prefill replace solo prefill exactly.
+    """
+    L = cache.k.shape[0]
+    MAXB, BS = load_row.shape[0], cache.positions.shape[1]
+    S = MAXB * BS
+    Tc = tokens.shape[1]
+
+    gk = cache.k[:, load_row].reshape(L, 1, S, *cache.k.shape[3:])
+    gv = cache.v[:, load_row].reshape(L, 1, S, *cache.v.shape[3:])
+    gpos = cache.positions[load_row].reshape(1, S)
+    idx = jnp.arange(S, dtype=jnp.int32)[None, :]
+    gpos = jnp.where(idx < n_hit, gpos, _UNFILLED)
+
+    positions = n_hit + jnp.arange(Tc, dtype=jnp.int32)[None, :]
+    positions = jnp.where(jnp.arange(Tc)[None, :] < n_real, positions,
+                          _UNFILLED)
+    kv_positions = jax.lax.dynamic_update_slice(gpos, positions,
+                                                (0, n_hit))
+
+    def write_kv(c, val):
+        return jax.lax.dynamic_update_slice(c, val, (0, n_hit, 0, 0))
+
+    logits, new_k, new_v = _run_blocks(
+        params, cfg, gk, gv, tokens, positions, kv_positions, write_kv)
+    last = logits[0, n_real - 1, :]
+    return last, new_k, new_v, kv_positions
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def paged_install(cache: PagedKVCache, temp_k, temp_v, temp_pos, slot,
+                  final_row, dest_row, write_idx0):
+    """Carve a prefilled temp strip into pool blocks and activate the
+    slot. ``dest_row`` (MAXB,) maps each strip chunk to its pool
+    destination: the request's OWN blocks for owned chunks, SINK for
+    chunks it shares (already in the pool — never overwrite a shared
+    block) and for tail chunks past its allocation. Every owned block
+    is fully overwritten — positions included — which is the
+    no-stale-reads guarantee for recycled blocks: whatever a block held
+    before, after install its visible state is exactly the fresh
+    strip's. ``write_idx0`` seats both counters at the REAL prompt
+    length, so the first generated token overwrites the first pad
+    column — the same offset solo ``generate_fused`` writes."""
+    L = cache.k.shape[0]
+    MAXB, BS = dest_row.shape[0], cache.positions.shape[1]
+    chunks_k = temp_k[:, 0].reshape(L, MAXB, BS, *temp_k.shape[3:])
+    chunks_v = temp_v[:, 0].reshape(L, MAXB, BS, *temp_v.shape[3:])
+    chunks_p = temp_pos[0].reshape(MAXB, BS)
+    return PagedKVCache(
+        k=cache.k.at[:, dest_row].set(chunks_k),
+        v=cache.v.at[:, dest_row].set(chunks_v),
+        positions=cache.positions.at[dest_row].set(chunks_p),
+        block_tables=cache.block_tables.at[slot].set(final_row),
+        write_idx=cache.write_idx.at[slot].set(write_idx0),
+        pos_next=cache.pos_next.at[slot].set(write_idx0),
+    )
+
+
+@jax.jit
+def gather_slot_strip(cache: PagedKVCache, slot):
+    """Debug/test helper: slot ``slot``'s logical strip as contiguous
+    (k (L, S, KVH, hd), v, positions (S,)) arrays."""
+    row = cache.block_tables[slot]
+    L = cache.k.shape[0]
+    MAXB, BS = row.shape[0], cache.positions.shape[1]
+    k = cache.k[:, row].reshape(L, MAXB * BS, *cache.k.shape[3:])
+    v = cache.v[:, row].reshape(L, MAXB * BS, *cache.v.shape[3:])
+    pos = cache.positions[row].reshape(MAXB * BS)
+    return k, v, pos
+
+
+# ---------------------------------------------------------------------------
+# host-side block accounting
+# ---------------------------------------------------------------------------
+
+
+def prefix_keys(tokens, block_size: int) -> list[tuple[int, bytes]]:
+    """Chained content keys for a prompt: one per full-block boundary
+    plus one for the trailing partial block. Key *i* digests
+    ``tokens[: covered_i]`` — the whole prefix, not just the block —
+    because a block's K/V depends on every token before it. Returns
+    ``[(covered_tokens, key), ...]`` in chain order."""
+    out: list[tuple[int, bytes]] = []
+    arr = np.asarray(list(tokens), np.int32)
+    h = hashlib.blake2b(digest_size=16)
+    full = len(arr) // block_size
+    for c in range(full):
+        h.update(arr[c * block_size:(c + 1) * block_size].tobytes())
+        out.append(((c + 1) * block_size, b"f" + h.digest()))
+    if len(arr) % block_size:
+        hp = h.copy()
+        hp.update(arr[full * block_size:].tobytes())
+        out.append((len(arr), b"p" + hp.digest()))
+    return out
+
+
+class BlockPool:
+    """Refcounted free-list + content-addressed prefix index over the
+    pool's block ids. Host-side only, driven by the single engine
+    thread (callers serialize via the gateway lock) — no lock here.
+
+    Lifecycle of a block: ``alloc`` (ref=1) → optionally ``register``
+    under a prefix key (content-addressed, sharable) → ``incref`` per
+    additional table that adopts it → ``decref`` per retiring table.
+    At ref 0 an *unregistered* block returns to the free list
+    immediately; a *registered* block is retained as prefix cache and
+    only evicted — oldest first — when ``alloc`` runs dry. ``alloc``
+    is atomic: it either returns ``n`` blocks or returns ``None``
+    having changed nothing (the clean-OOM contract admission relies
+    on)."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks <= RESERVED_BLOCKS:
+            raise ValueError(
+                f"num_blocks {num_blocks} leaves no usable blocks")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free: deque[int] = deque(range(RESERVED_BLOCKS,
+                                             num_blocks))
+        self._ref: dict[int, int] = {}
+        self._index: OrderedDict[bytes, int] = OrderedDict()
+        self._block_key: dict[int, bytes] = {}
+        self.cow_forks = 0
+        self.evictions = 0
+        self.alloc_failures = 0
+
+    # -- capacity ------------------------------------------------------
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - RESERVED_BLOCKS
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def evictable_count(self) -> int:
+        return sum(1 for b in self._block_key
+                   if self._ref.get(b, 0) == 0)
+
+    def available(self) -> int:
+        """Blocks an alloc could hand out right now: free + evictable
+        retained prefix blocks."""
+        return self.free_count() + self.evictable_count()
+
+    def ref_of(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    # -- alloc / refcount ----------------------------------------------
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` blocks at ref 1, or ``None`` with NO state change."""
+        if n <= 0:
+            return []
+        if self.available() < n:
+            self.alloc_failures += 1
+            return None
+        out: list[int] = []
+        while len(out) < n:
+            if self._free:
+                b = self._free.popleft()
+            else:
+                b = self._evict_one()
+            self._ref[b] = 1
+            out.append(b)
+        return out
+
+    def _evict_one(self) -> int:
+        for key, b in self._index.items():     # oldest entry first
+            if self._ref.get(b, 0) == 0:
+                del self._index[key]
+                del self._block_key[b]
+                self.evictions += 1
+                return b
+        raise RuntimeError("evict with no evictable block "
+                           "(available() said otherwise)")
+
+    def incref(self, blocks) -> None:
+        for b in blocks:
+            self._ref[b] = self._ref.get(b, 0) + 1
+            key = self._block_key.get(b)
+            if key is not None:                # LRU touch
+                self._index.move_to_end(key)
+
+    def decref(self, blocks) -> None:
+        for b in blocks:
+            r = self._ref.get(b, 0) - 1
+            if r < 0:
+                raise RuntimeError(f"decref of block {b} below zero")
+            self._ref[b] = r
+            if r == 0 and b not in self._block_key:
+                self._free.append(b)
+
+    # -- prefix index --------------------------------------------------
+
+    def lookup(self, key: bytes) -> int | None:
+        b = self._index.get(key)
+        if b is not None:
+            self._index.move_to_end(key)
+        return b
+
+    def register(self, key: bytes, block: int) -> int:
+        """Publish ``block`` under ``key``; first writer wins (an
+        identical prefix prefilled twice registers once — the second
+        block simply frees on retire)."""
+        existing = self._index.get(key)
+        if existing is not None:
+            self._index.move_to_end(key)
+            return existing
+        if block in self._block_key:           # one key per block
+            return self._index[self._block_key[block]]
+        self._index[key] = block
+        self._block_key[block] = key
+        return block
+
+    def lookup_chain(self, keys) -> list[int]:
+        """Longest CONSECUTIVE run of ``keys`` present in the index
+        (a later hit without its predecessors is unusable — the table
+        needs every chunk up to the hit). Returns the blocks."""
+        out: list[int] = []
+        for _covered, key in keys:
+            b = self.lookup(key)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "blocks_total": self.usable_blocks,
+            "blocks_free": self.free_count(),
+            "blocks_evictable": self.evictable_count(),
+            "blocks_available": self.available(),
+            "free_block_fraction": (self.available()
+                                    / max(1, self.usable_blocks)),
+            "prefix_entries": len(self._index),
+            "cow_forks": self.cow_forks,
+            "evictions": self.evictions,
+            "alloc_failures": self.alloc_failures,
+        }

@@ -855,7 +855,17 @@ def main() -> int:
                     help="fleet size for the chaos arm (prefix_storm)")
     ap.add_argument("--out", default=None,
                     help="also write the JSON to this path")
+    ap.add_argument("--jaxcheck-out", default=None,
+                    help="enable the jit-cache sentinel for the "
+                         "campaign and write its report (signature "
+                         "counts, limits, witnesses) here; exits "
+                         "nonzero if any entry exceeded its bucket "
+                         "bound")
     args = ap.parse_args()
+    if args.jaxcheck_out:
+        from kubeflow_rm_tpu.analysis.jaxcheck import recompile
+        recompile.set_enabled(True)
+        recompile.reset()
     if args.campaign == "serve":
         out = serve_campaign(args.preset, args.quant, args.requests,
                              args.concurrency, args.max_new)
@@ -910,6 +920,21 @@ def main() -> int:
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
+    if args.jaxcheck_out:
+        from kubeflow_rm_tpu.analysis.jaxcheck import recompile
+        findings = recompile.over_limit()
+        audit = {
+            "run_meta": out.get("run_meta"),
+            "report": recompile.report(),
+            "over_limit": findings,
+        }
+        with open(args.jaxcheck_out, "w") as f:
+            json.dump(audit, f, indent=1)
+        if findings:
+            print(f"jaxcheck: {len(findings)} jit entries over their "
+                  f"recompile limit (see {args.jaxcheck_out})",
+                  file=sys.stderr)
+            return 1
     return 0
 
 

@@ -1,0 +1,34 @@
+"""jaxcheck: jaxpr-level TPU program auditing.
+
+The compute-side sibling of the concurrency toolkit (``analysis/lint``,
+``analysis/lockgraph``): where lockgraph audits what the control plane's
+threads do to each other, jaxcheck audits what the compute path does to
+the chip. Four probes, one artifact:
+
+- :mod:`.costmodel` — a jaxpr walker with a per-primitive FLOPs/bytes
+  model and a live-range peak-HBM estimator that honors buffer
+  donation (``donate_argnums``/``donate_argnames``), so "this config
+  OOMs" becomes a prediction instead of a burned TPU-hour;
+- :mod:`.memplan` — runs the cost model over the full-FT ladder and
+  emits ``MEMPLAN_r01.json``, validated against the measured
+  BENCH_SWEEP_r05 rungs and extrapolated to the 7B north star;
+- :mod:`.recompile` — an opt-in jit-cache sentinel
+  (``KFRM_JIT_SENTINEL=1``, zero cost when off) that records
+  (shape, dtype, static-arg) signatures per jitted entry point and
+  flags unbounded growth — the static-shape discipline the serving
+  engine's prefill buckets exist to enforce;
+- :mod:`.hostsync` — probes for implicit device→host transfers
+  (``bool()``, ``.item()``, ``np.asarray`` on device arrays) inside
+  decode/train loops, reported with witness stacks like lockgraph's
+  blocking-under-lock findings.
+
+The static halves are lint rules KFRM006-008 in ``analysis/lint``;
+``python -m kubeflow_rm_tpu.analysis.jaxcheck`` runs them plus a
+cost-model self-check as the CI gate.
+"""
+
+from __future__ import annotations
+
+from .costmodel import CostEstimate, estimate, estimate_jaxpr, selfcheck
+
+__all__ = ["CostEstimate", "estimate", "estimate_jaxpr", "selfcheck"]

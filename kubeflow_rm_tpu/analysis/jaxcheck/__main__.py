@@ -1,0 +1,65 @@
+"""CLI: ``python -m kubeflow_rm_tpu.analysis.jaxcheck [paths...]``.
+
+The compute-path audit gate: runs the jaxcheck lint rules
+(KFRM006-008) over the tree AND the cost model's self-check
+(:func:`costmodel.selfcheck` — exact FLOPs on a known matmul, the
+donation double-buffer proof, scan trip-count accounting). Exit
+status: 0 clean, 1 findings or a failed self-check, 2 usage error —
+the same contract as ``analysis.lint``, so CI wires both identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..lint import lint_paths
+
+#: the compute-path rules this gate owns (KFRM001-005 stay with the
+#: concurrency gate)
+JAXCHECK_RULES = frozenset({"KFRM006", "KFRM007", "KFRM008"})
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_rm_tpu.analysis.jaxcheck",
+        description="jaxpr-level TPU program audit: lint rules "
+                    "KFRM006-008 + cost-model self-check")
+    parser.add_argument("paths", nargs="*", default=["kubeflow_rm_tpu"],
+                        help="files or directories (default: "
+                             "kubeflow_rm_tpu)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--no-selfcheck", action="store_true",
+                        help="skip the cost-model self-check (lint "
+                             "only; the CI gate never passes this)")
+    args = parser.parse_args(argv)
+
+    findings = lint_paths(args.paths or ["kubeflow_rm_tpu"],
+                          set(JAXCHECK_RULES))
+
+    failures: list[str] = []
+    if not args.no_selfcheck:
+        from .costmodel import selfcheck
+        failures = selfcheck()
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "selfcheck_failures": failures,
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        for msg in failures:
+            print(f"costmodel selfcheck: {msg}")
+        if findings or failures:
+            print(f"\n{len(findings)} finding(s), "
+                  f"{len(failures)} selfcheck failure(s)",
+                  file=sys.stderr)
+    return 1 if (findings or failures) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

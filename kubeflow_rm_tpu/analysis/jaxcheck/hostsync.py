@@ -1,0 +1,193 @@
+"""An opt-in probe for implicit device→host transfers in hot loops.
+
+``bool(x)``, ``x.item()``, ``int(x)``, ``float(x)`` and
+``np.asarray(x)`` on a device array all block the Python thread until
+the TPU stream drains and the value lands on host — one hidden
+round-trip per token in a decode loop, or per step in a training
+loop, is enough to serialize the accelerator behind Python. The
+static half of this audit is lint rule KFRM006; this module is the
+dynamic half: it patches the sync entry points on jax's array class
+and records a witness (with the enclosing region and the first 12
+stack frames — lockgraph's witness convention) for every implicit
+sync that fires inside a declared hot region.
+
+Same contract as :mod:`..lockgraph`: **off by default, zero cost when
+off** — ``region()`` returns a shared null context manager and no
+patching happens until :func:`install` runs. Enable with
+``KFRM_HOSTSYNC_PROBE=1`` (or :func:`set_enabled` + :func:`install`).
+
+Usage::
+
+    from kubeflow_rm_tpu.analysis.jaxcheck import hostsync
+    hostsync.install()                     # no-op unless enabled
+    with hostsync.region("decode-step"):
+        ...                                # hot loop body
+    hostsync.witnesses()                   # -> [{kind, region, stack}]
+
+Deliberate syncs are fine outside regions (a metrics fetch at a log
+boundary); witnesses are only recorded while a region is open on the
+calling thread, so instrumenting a loop costs nothing in reports
+unless the loop actually syncs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import traceback
+
+_ENV = "KFRM_HOSTSYNC_PROBE"
+_enabled = os.environ.get(_ENV, "").strip().lower() not in (
+    "", "0", "false", "no")
+
+_STACK_LIMIT = 12
+
+# the probe's own guard cannot come from the lockgraph factory —
+# instrumentation must not recurse into the instrumented layer
+# (same exemption lockgraph.py itself takes).
+_lock = threading.Lock()  # kfrm: disable=KFRM001
+_witnesses: list[dict] = []
+_installed = False
+_originals: list[tuple] = []   # (owner, attr, original) for uninstall
+_tls = threading.local()
+
+#: the implicit-sync entry points on jax's concrete array class.
+#: ``__array__`` is deliberately absent: numpy reaches the array's
+#: buffer via the C protocol, bypassing a Python-level patch — the
+#: ``np.asarray``/``np.array`` call sites are wrapped instead.
+_SYNC_METHODS = ("__bool__", "__int__", "__float__", "__index__",
+                 "item", "tolist")
+_NUMPY_FUNCS = ("asarray", "array")
+
+
+def enabled() -> bool:
+    """Whether the probe is active."""
+    return _enabled
+
+
+def set_enabled(value: bool) -> None:
+    """Programmatic override of the ``KFRM_HOSTSYNC_PROBE`` gate."""
+    global _enabled
+    _enabled = bool(value)
+
+
+def _regions() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+# nullcontext is reusable AND reentrant, so one shared instance
+# serves every disabled region() call forever — zero allocation on
+# the production path
+_NULL = contextlib.nullcontext()
+
+
+def region(name: str):
+    """Declare a hot region: implicit syncs on this thread are
+    recorded as witnesses while it is open. Returns a shared null
+    context manager when the probe is disabled — safe to leave in
+    production loops."""
+    if not _enabled:
+        return _NULL
+
+    @contextlib.contextmanager
+    def _cm():
+        _regions().append(name)
+        try:
+            yield
+        finally:
+            _regions().pop()
+
+    return _cm()
+
+
+def _record(kind: str) -> None:
+    stack = _regions()
+    if not stack:
+        return
+    frames = traceback.format_list(
+        traceback.extract_stack(limit=_STACK_LIMIT)[:-2])
+    with _lock:
+        _witnesses.append({
+            "kind": kind,
+            "region": stack[-1],
+            "stack": "".join(frames),
+        })
+
+
+def _wrap(cls, name: str):
+    orig = getattr(cls, name)
+
+    def probe(self, *args, **kwargs):
+        _record(name)
+        return orig(self, *args, **kwargs)
+
+    probe.__name__ = name
+    probe.__qualname__ = f"{cls.__name__}.{name}"
+    return orig, probe
+
+
+def install() -> bool:
+    """Patch the sync entry points on jax's concrete array class.
+
+    Idempotent; returns True if the probe is (now) installed. No-op
+    when disabled — importing jax is deferred to here, so a disabled
+    probe costs nothing at import time.
+    """
+    global _installed
+    if not _enabled:
+        return False
+    with _lock:
+        if _installed:
+            return True
+        import jax
+        import numpy as np
+
+        cls = type(jax.numpy.zeros(()))
+        for name in _SYNC_METHODS:
+            if not hasattr(cls, name):
+                continue
+            orig, probe = _wrap(cls, name)
+            _originals.append((cls, name, orig))
+            setattr(cls, name, probe)
+
+        def _np_wrap(label, orig):
+            def probe(a, *args, **kwargs):
+                if isinstance(a, cls):
+                    _record(label)
+                return orig(a, *args, **kwargs)
+
+            probe.__name__ = orig.__name__
+            return probe
+
+        for fname in _NUMPY_FUNCS:
+            orig = getattr(np, fname)
+            _originals.append((np, fname, orig))
+            setattr(np, fname, _np_wrap(f"np.{fname}", orig))
+        _installed = True
+        return True
+
+
+def uninstall() -> None:
+    """Restore the original methods (tests pair this with install)."""
+    global _installed
+    with _lock:
+        for owner, name, orig in _originals:
+            setattr(owner, name, orig)
+        _originals.clear()
+        _installed = False
+
+
+def witnesses() -> list[dict]:
+    """All recorded implicit-sync witnesses."""
+    with _lock:
+        return list(_witnesses)
+
+
+def reset() -> None:
+    """Drop recorded witnesses (the patch, if installed, remains)."""
+    with _lock:
+        _witnesses.clear()

@@ -1,0 +1,185 @@
+"""An opt-in jit-cache sentinel: catch unbounded retracing in the
+serving/training hot paths.
+
+Every distinct (shape, dtype, static-arg) signature a jitted entry
+point sees is a fresh XLA compile — seconds of latency and a cache
+entry that never goes away. The serving engine's prefill buckets
+(``models/generate.py _bucket_len``) exist precisely to bound this:
+ragged request lengths collapse onto power-of-two buckets, so the
+signature count stays at most ``log2(slot_len) + 1`` no matter what
+the storm looks like. Nothing asserted that invariant — this module
+does.
+
+Same contract as :mod:`..lockgraph`: **off by default, zero cost when
+off**. Enable with ``KFRM_JIT_SENTINEL=1`` (or :func:`set_enabled`)
+and the instrumented call sites record each entry point's argument
+signatures; :func:`over_limit` reports any entry whose signature
+count exceeded its declared bucket bound, with a witness stack (first
+12 frames) for the signature that crossed the line — the lockgraph
+witness convention.
+
+Instrumentation points (all no-ops when disabled):
+
+- ``note(entry, *args, **static)`` — record the signature the entry
+  point is about to be called with. Arrays contribute
+  ``(shape, dtype)`` per leaf; everything else is static and
+  contributes its ``repr``.
+- ``set_limit(entry, n)`` — declare the expected signature bound
+  (the engine declares ``log2(slot_len) + 1`` prefill buckets).
+- ``track(entry, fn)`` — associate the actual jitted callable so
+  :func:`report` can cross-check the recorded signature count
+  against ``fn._cache_size()`` (the compiled-executable count XLA
+  itself holds).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+_ENV = "KFRM_JIT_SENTINEL"
+_enabled = os.environ.get(_ENV, "").strip().lower() not in (
+    "", "0", "false", "no")
+
+_STACK_LIMIT = 12
+
+# the probe's own guard cannot come from the lockgraph factory —
+# instrumentation must not recurse into the instrumented layer
+# (same exemption lockgraph.py itself takes).
+_lock = threading.Lock()  # kfrm: disable=KFRM001
+_entries: dict[str, dict] = {}
+_tracked: dict[str, object] = {}
+
+
+def enabled() -> bool:
+    """Whether the sentinel is recording."""
+    return _enabled
+
+
+def set_enabled(value: bool) -> None:
+    """Programmatic override of the ``KFRM_JIT_SENTINEL`` gate (tests
+    flip this instead of mutating the environment)."""
+    global _enabled
+    _enabled = bool(value)
+
+
+def reset() -> None:
+    """Drop all recorded signatures, limits and tracked callables."""
+    with _lock:
+        _entries.clear()
+        _tracked.clear()
+
+
+def _signature(args: tuple, static: dict) -> tuple:
+    """A hashable compile signature: (shape, dtype) per array leaf,
+    ``repr`` for everything else — the same partitioning jit's tracing
+    cache keys on for a bucketed call site."""
+    import jax
+
+    parts = []
+    for a in args:
+        for leaf in jax.tree_util.tree_leaves(a):
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                parts.append((tuple(leaf.shape), str(leaf.dtype)))
+            else:
+                parts.append(repr(leaf))
+    for k in sorted(static):
+        parts.append((k, repr(static[k])))
+    return tuple(parts)
+
+
+def _entry(name: str) -> dict:
+    e = _entries.get(name)
+    if e is None:
+        e = _entries[name] = {"signatures": {}, "limit": None,
+                              "witnesses": []}
+    return e
+
+
+def set_limit(entry: str, limit: int) -> None:
+    """Declare the expected signature bound for ``entry``."""
+    if not _enabled:
+        return
+    with _lock:
+        _entry(entry)["limit"] = int(limit)
+
+
+def track(entry: str, fn) -> None:
+    """Associate the jitted callable behind ``entry`` so ``report()``
+    can read its real compile-cache size."""
+    if not _enabled:
+        return
+    with _lock:
+        _tracked[entry] = fn
+
+
+def note(entry: str, *args, **static) -> None:
+    """Record the signature ``entry`` is being called with.
+
+    Call this immediately before the jitted call with the same
+    positional arrays and keyword statics. No-op (one attribute read)
+    when the sentinel is disabled.
+    """
+    if not _enabled:
+        return
+    sig = _signature(args, static)
+    with _lock:
+        e = _entry(entry)
+        seen = e["signatures"]
+        if sig in seen:
+            seen[sig] += 1
+            return
+        seen[sig] = 1
+        limit = e["limit"]
+        if limit is not None and len(seen) > limit:
+            stack = traceback.format_list(
+                traceback.extract_stack(limit=_STACK_LIMIT)[:-1])
+            e["witnesses"].append({
+                "entry": entry,
+                "signature": sig,
+                "count": len(seen),
+                "limit": limit,
+                "stack": "".join(stack),
+            })
+
+
+def cache_size(entry: str) -> int | None:
+    """The tracked callable's real compiled-executable count, or None
+    if the entry isn't tracked / the callable doesn't expose it."""
+    fn = _tracked.get(entry)
+    size = getattr(fn, "_cache_size", None)
+    return size() if callable(size) else None
+
+
+def report() -> dict:
+    """Per-entry signature counts, limits, cache sizes and witnesses."""
+    with _lock:
+        out = {}
+        for name, e in _entries.items():
+            out[name] = {
+                "signatures": len(e["signatures"]),
+                "calls": sum(e["signatures"].values()),
+                "limit": e["limit"],
+                "jit_cache_size": cache_size(name),
+                "witnesses": list(e["witnesses"]),
+            }
+        return out
+
+
+def over_limit() -> list[dict]:
+    """Entries whose recorded signature count exceeds their declared
+    limit — each with the witness stacks for the crossing signatures.
+    Empty list == the storm stayed inside its buckets."""
+    findings = []
+    for name, info in report().items():
+        if info["limit"] is not None and \
+                info["signatures"] > info["limit"]:
+            findings.append({
+                "entry": name,
+                "signatures": info["signatures"],
+                "limit": info["limit"],
+                "jit_cache_size": info["jit_cache_size"],
+                "witnesses": info["witnesses"],
+            })
+    return findings

@@ -1,4 +1,4 @@
-"""The five KFRM rules, one visitor class each.
+"""The eight KFRM rules, one visitor class each.
 
 | Rule    | Invariant                                               |
 |---------|---------------------------------------------------------|
@@ -7,6 +7,15 @@
 | KFRM003 | manual ``.acquire()`` has a ``try/finally`` release     |
 | KFRM004 | no apiserver/kubeclient write while a lock is held      |
 | KFRM005 | ``except Exception:`` must log, count, or re-raise      |
+| KFRM006 | no scalar host-sync on a jitted result inside a loop    |
+| KFRM007 | no ``jax.jit`` construction inside a loop body          |
+| KFRM008 | a jitted step must donate its state/cache argument      |
+
+KFRM001-005 audit the control plane's locking (PR 11); KFRM006-008
+are the static half of ``analysis/jaxcheck`` and audit the compute
+path's TPU discipline — each one encodes a stall class the jaxcheck
+dynamic probes (``hostsync``, ``recompile``, ``costmodel``) can
+demonstrate at runtime.
 
 All are heuristics biased toward catching real violations in *this*
 codebase's idiom; the escape hatch for a justified exception is a
@@ -233,10 +242,271 @@ class SilentSwallow(Rule):
         self.generic_visit(node)
 
 
+_STATEY = ("state", "cache")
+
+
+def _is_statey(name: str) -> bool:
+    """A parameter that names a donatable step buffer: ``state``,
+    ``cache``, ``*_state``, ``*_cache``."""
+    return any(name == s or name.endswith("_" + s) for s in _STATEY)
+
+
+class _JitAwareRule(Rule):
+    """Base for the jaxcheck rules (KFRM006-008): tracks how this
+    file refers to ``jax.jit`` (dotted, or ``from jax import jit``
+    aliases) and recognizes the three construction idioms — a direct
+    ``jax.jit(...)`` call, a ``partial(jax.jit, ...)`` wrapper, and
+    either of those as a decorator."""
+
+    def __init__(self, path: str):
+        super().__init__(path)
+        self._jit_refs = {"jax.jit"}
+
+    def _scan_imports(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "jit":
+                        self._jit_refs.add(alias.asname or "jit")
+
+    def _is_jit_ref(self, node: ast.AST) -> bool:
+        return dotted(node) in self._jit_refs
+
+    def _jit_construction(self, call: ast.Call):
+        """If ``call`` builds a jitted callable, return
+        ``(wrapped, kwargs)`` — the wrapped function expression (None
+        for the partial form, whose target arrives later) and the jit
+        keyword nodes. Otherwise None."""
+        if self._is_jit_ref(call.func):
+            wrapped = call.args[0] if call.args else None
+            return wrapped, {kw.arg: kw.value
+                             for kw in call.keywords if kw.arg}
+        if dotted(call.func) in ("functools.partial", "partial") and \
+                call.args and self._is_jit_ref(call.args[0]):
+            return None, {kw.arg: kw.value
+                          for kw in call.keywords if kw.arg}
+        return None
+
+    def _decorator_jit_kwargs(self, dec: ast.AST):
+        """jit kwargs if ``dec`` is a jit decorator (any idiom), else
+        None."""
+        if self._is_jit_ref(dec):
+            return {}
+        if isinstance(dec, ast.Call):
+            built = self._jit_construction(dec)
+            if built is not None:
+                return built[1]
+        return None
+
+
+class _LoopScopeMixin:
+    """Loop-depth tracking with the LockScopeRule scope convention:
+    nested function/lambda bodies run later, not per iteration, so
+    depth resets across them."""
+
+    _loop_depth = 0
+
+    def _visit_loop(self, node) -> None:
+        self._loop_depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            self._loop_depth -= 1
+
+    def visit_For(self, node) -> None:
+        self._visit_loop(node)
+
+    def visit_While(self, node) -> None:
+        self._visit_loop(node)
+
+    def _visit_scope(self, node) -> None:
+        saved, self._loop_depth = self._loop_depth, 0
+        try:
+            self.generic_visit(node)
+        finally:
+            self._loop_depth = saved
+
+    def visit_FunctionDef(self, node) -> None:
+        self._visit_scope(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_scope(node)
+
+    def visit_Lambda(self, node) -> None:
+        self._visit_scope(node)
+
+
+class ScalarSyncInJitLoop(_LoopScopeMixin, _JitAwareRule):
+    """KFRM006: ``int()``/``.item()``/``np.asarray()`` on a jitted
+    call's result inside a loop blocks Python on a device→host
+    round trip every iteration — the decode loop serializes the TPU
+    behind the host. Batch the results and sync once outside, or keep
+    the consumer on-device. The dynamic twin is
+    ``jaxcheck.hostsync``."""
+
+    rule_id = "KFRM006"
+    synopsis = "scalar host-sync on a jitted result inside a loop"
+
+    _SYNC_BUILTINS = {"int", "float", "bool"}
+    _SYNC_ATTRS = {"item", "tolist"}
+    _SYNC_DOTTED = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "jax.device_get"}
+
+    def run(self, tree: ast.AST) -> list:
+        self._scan_imports(tree)
+        # names bound to jitted callables: decorated defs and
+        # ``f = jax.jit(...)`` / ``f = partial(jax.jit, ...)(...)``
+        self._jitted: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._decorator_jit_kwargs(dec) is not None:
+                        self._jitted.add(node.name)
+            elif isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Call) and \
+                    self._jit_construction(node.value) is not None:
+                self._jitted.add(node.targets[0].id)
+        return super().run(tree)
+
+    def _is_jitted_call(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and \
+            terminal_name(node.func) in self._jitted
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._loop_depth > 0:
+            sync = None
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in self._SYNC_BUILTINS and \
+                    node.args and self._is_jitted_call(node.args[0]):
+                sync = f"{node.func.id}()"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in self._SYNC_ATTRS and \
+                    self._is_jitted_call(node.func.value):
+                sync = f".{node.func.attr}()"
+            elif dotted(node.func) in self._SYNC_DOTTED and \
+                    node.args and self._is_jitted_call(node.args[0]):
+                sync = f"{dotted(node.func)}()"
+            if sync:
+                self.emit(node, f"{sync} on a jitted result inside a "
+                                f"loop forces a device->host sync "
+                                f"every iteration — batch the results "
+                                f"and sync once outside the loop")
+        self.generic_visit(node)
+
+
+class JitConstructionInLoop(_LoopScopeMixin, _JitAwareRule):
+    """KFRM007: ``jax.jit(...)`` constructed inside a loop body makes
+    a fresh callable — and a fresh trace/compile cache — every
+    iteration; nothing is ever reused. Hoist ONE jitted function out
+    of the loop and key per-iteration variation on
+    ``static_argnames``. The dynamic twin is
+    ``jaxcheck.recompile``."""
+
+    rule_id = "KFRM007"
+    synopsis = "jax.jit constructed inside a loop body"
+
+    def run(self, tree: ast.AST) -> list:
+        self._scan_imports(tree)
+        return super().run(tree)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._loop_depth > 0 and \
+                self._jit_construction(node) is not None:
+            self.emit(node, "jax.jit constructed inside a loop — a "
+                            "fresh trace cache per iteration; hoist "
+                            "one jitted function and pass the "
+                            "varying parts via static_argnames")
+        self.generic_visit(node)
+
+
+class NonDonatedStateJit(_JitAwareRule):
+    """KFRM008: a jitted step that takes a ``state``/``cache``
+    argument and returns its successor must donate it
+    (``donate_argnums``/``donate_argnames``) — otherwise XLA keeps
+    the old buffer live across the call and the step double-buffers
+    the largest allocation in the program (the cost model's
+    ``peak_bytes_no_donation`` column prices exactly this)."""
+
+    rule_id = "KFRM008"
+    synopsis = "jitted step does not donate its state/cache argument"
+
+    def run(self, tree: ast.AST) -> list:
+        self._scan_imports(tree)
+        # every def in the file (any nesting), for call-form lookup
+        self._defs: dict[str, ast.arguments] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs[node.name] = node.args
+        return super().run(tree)
+
+    @staticmethod
+    def _literal(node: ast.AST):
+        try:
+            return ast.literal_eval(node)
+        except (ValueError, TypeError, SyntaxError):
+            return None
+
+    def _check(self, site: ast.AST, fn_name: str,
+               args: ast.arguments, kwargs: dict) -> None:
+        params = [a.arg for a in args.args]
+        statey = [(i, p) for i, p in enumerate(params) if _is_statey(p)]
+        if not statey:
+            return
+        donated_nums = self._literal(kwargs["donate_argnums"]) \
+            if "donate_argnums" in kwargs else ()
+        donated_names = self._literal(kwargs["donate_argnames"]) \
+            if "donate_argnames" in kwargs else ()
+        statics = self._literal(kwargs["static_argnames"]) \
+            if "static_argnames" in kwargs else ()
+        if donated_nums is None or donated_names is None or \
+                statics is None:
+            return  # non-literal donation spec: assume handled
+        if isinstance(donated_nums, int):
+            donated_nums = (donated_nums,)
+        if isinstance(donated_names, str):
+            donated_names = (donated_names,)
+        if isinstance(statics, str):
+            statics = (statics,)
+        for i, p in statey:
+            if i in donated_nums or p in donated_names or p in statics:
+                continue
+            self.emit(site, f"{fn_name} is jitted with a '{p}' "
+                            f"argument (position {i}) that is not "
+                            f"donated — the old buffer stays live and "
+                            f"the step double-buffers it; add "
+                            f"donate_argnums=({i},)")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for dec in node.decorator_list:
+            kwargs = self._decorator_jit_kwargs(dec)
+            if kwargs is not None:
+                self._check(node, node.name, node.args, kwargs)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        built = self._jit_construction(node)
+        if built is not None:
+            wrapped, kwargs = built
+            if isinstance(wrapped, ast.Lambda):
+                self._check(node, "<lambda>", wrapped.args, kwargs)
+            elif isinstance(wrapped, ast.Name) and \
+                    wrapped.id in self._defs:
+                self._check(node, wrapped.id, self._defs[wrapped.id],
+                            kwargs)
+        self.generic_visit(node)
+
+
 ALL_RULES: tuple[type[Rule], ...] = (
     RawLockConstruction,
     BlockingUnderLock,
     AcquireWithoutFinally,
     WriteUnderLock,
     SilentSwallow,
+    ScalarSyncInJitLoop,
+    JitConstructionInLoop,
+    NonDonatedStateJit,
 )

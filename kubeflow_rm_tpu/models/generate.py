@@ -32,6 +32,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from kubeflow_rm_tpu.analysis.jaxcheck import hostsync as _hostsync
+from kubeflow_rm_tpu.analysis.jaxcheck import recompile as _jit_sentinel
 from kubeflow_rm_tpu.models.llama import LlamaConfig
 from kubeflow_rm_tpu.models.lora import lora_proj
 from kubeflow_rm_tpu.models.quantize import maybe_dequant, unpack_int4_params
@@ -654,9 +656,13 @@ def init_slot_cache(cfg: LlamaConfig, slots: int,
     )
 
 
+# row_cache is consumed read-only: its (B=1, S) buffers are gathered
+# into the pool and cannot alias any output shape, so donating it
+# would only draw an unused-donation warning; the pool itself IS
+# donated.
 @partial(jax.jit, donate_argnames=("cache",))
-def _install_row(cache: SlotCache, row_cache: KVCache, row: jax.Array,
-                 n_real: jax.Array) -> SlotCache:
+def _install_row(cache: SlotCache, row_cache: KVCache,  # kfrm: disable=KFRM008
+                 row: jax.Array, n_real: jax.Array) -> SlotCache:
     """Copy a freshly-prefilled single-request cache (B=1, same S) into
     slot ``row`` of the pool. ``n_real`` is the request's REAL prompt
     length (sans left-pad): the slot resumes at position n_real while
@@ -843,6 +849,20 @@ class ContinuousBatchingEngine:
         self.admitted_by_class = {c: 0 for c in SLO_CLASSES}
         self.prefix_hit_tokens = 0
         self.prompt_tokens = 0
+        if _jit_sentinel.enabled():
+            # prompt lengths bucket to powers of two (_bucket_len), so
+            # a pow-2 slot_len admits at most log2(slot_len)+1 prefill
+            # shapes; decode always runs the full (slots,) batch — ONE
+            # shape, ever. The sentinel turns both into assertions.
+            _jit_sentinel.set_limit("engine.prefill",
+                                    slot_len.bit_length())
+            _jit_sentinel.set_limit("engine.decode_step", 1)
+            _jit_sentinel.track(
+                "engine.prefill",
+                paging.paged_prefill if paged else _decode_step)
+            _jit_sentinel.track(
+                "engine.decode_step",
+                paging.paged_decode_step if paged else slot_decode_step)
 
     # -- request lifecycle -------------------------------------------------
 
@@ -939,8 +959,10 @@ class ContinuousBatchingEngine:
         padded = jnp.asarray([[0] * (Tb - Tp) + req.prompt], jnp.int32)
         pads = jnp.asarray([Tb - Tp], jnp.int32)
         tmp = init_cache(self.cfg, 1, self.slot_len)
-        logits, tmp = _decode_step(self.params, self.cfg, tmp,
-                                   padded, pads)
+        _jit_sentinel.note("engine.prefill", padded)
+        with _hostsync.region("engine.prefill"):
+            logits, tmp = _decode_step(self.params, self.cfg, tmp,
+                                       padded, pads)
         self.cache = _install_row(
             self.cache, tmp, jnp.asarray(i, jnp.int32),
             jnp.asarray(Tp, jnp.int32))
@@ -1009,11 +1031,13 @@ class ContinuousBatchingEngine:
         Tc = _bucket_len(len(suffix))
         padded = jnp.asarray([suffix + [0] * (Tc - len(suffix))],
                              jnp.int32)
-        last, tk, tv, tpos = paging.paged_prefill(
-            self.params, self.cfg, self.cache,
-            jnp.asarray(load_row, jnp.int32),
-            jnp.asarray(n_hit, jnp.int32), padded,
-            jnp.asarray(len(suffix), jnp.int32))
+        _jit_sentinel.note("engine.prefill", padded)
+        with _hostsync.region("engine.prefill"):
+            last, tk, tv, tpos = paging.paged_prefill(
+                self.params, self.cfg, self.cache,
+                jnp.asarray(load_row, jnp.int32),
+                jnp.asarray(n_hit, jnp.int32), padded,
+                jnp.asarray(len(suffix), jnp.int32))
         self.cache = paging.paged_install(
             self.cache, tk, tv, tpos, jnp.asarray(i, jnp.int32),
             jnp.asarray(final_row, jnp.int32),
@@ -1050,7 +1074,11 @@ class ContinuousBatchingEngine:
                 req.key, sub = jax.random.split(req.key)
             else:
                 sub = None
-            nxt = int(_pick_row(self._last[i], sub,
+            # the ONE deliberate sync per token boundary: the sampled
+            # token drives host-side scheduling (EOS retirement,
+            # admission) and cannot stay on device.  hostsync.region
+            # in callers documents the same budget dynamically.
+            nxt = int(_pick_row(self._last[i], sub,  # kfrm: disable=KFRM006
                                 temperature=req.temperature,
                                 top_k=req.top_k))
             req.tokens.append(nxt)
@@ -1066,17 +1094,20 @@ class ContinuousBatchingEngine:
                 active[i] = True
         n_active = sum(active)
         if n_active:
+            tok_arr = jnp.asarray(tokens, jnp.int32)
+            act_arr = jnp.asarray(active)
+            _jit_sentinel.note("engine.decode_step", tok_arr, act_arr)
             if self.paged:
                 from kubeflow_rm_tpu.models import paging
-                last, self.cache = paging.paged_decode_step(
-                    self.params, self.cfg, self.cache,
-                    jnp.asarray(tokens, jnp.int32),
-                    jnp.asarray(active))
+                with _hostsync.region("engine.decode"):
+                    last, self.cache = paging.paged_decode_step(
+                        self.params, self.cfg, self.cache,
+                        tok_arr, act_arr)
             else:
-                last, self.cache = slot_decode_step(
-                    self.params, self.cfg, self.cache,
-                    jnp.asarray(tokens, jnp.int32),
-                    jnp.asarray(active))
+                with _hostsync.region("engine.decode"):
+                    last, self.cache = slot_decode_step(
+                        self.params, self.cfg, self.cache,
+                        tok_arr, act_arr)
             for i in range(self.slots):
                 if active[i]:
                     self._last[i] = last[i]

@@ -165,9 +165,13 @@ def paged_decode_step(params, cfg, cache: PagedKVCache, tokens, active):
     return logits[:, -1, :], new_cache
 
 
+# cache is READ-ONLY here: prefill gathers the shared-prefix strip
+# out of the pool and writes a fresh single-request row cache; the
+# pool has no successor to alias, and donating it would free buffers
+# the engine still serves other slots from.
 @partial(jax.jit, static_argnames=("cfg",))
-def paged_prefill(params, cfg, cache: PagedKVCache, load_row, n_hit,
-                  tokens, n_real):
+def paged_prefill(params, cfg, cache: PagedKVCache,  # kfrm: disable=KFRM008
+                  load_row, n_hit, tokens, n_real):
     """Prefill one request's suffix against its cached prefix.
 
     ``load_row`` (MAXB,) names the SOURCE blocks of the shared prefix
@@ -241,8 +245,11 @@ def paged_install(cache: PagedKVCache, temp_k, temp_v, temp_pos, slot,
     )
 
 
+# debug/test helper: reads the pool into a contiguous strip for
+# inspection — the cache must survive the call, donation would be a
+# use-after-free for the engine.
 @jax.jit
-def gather_slot_strip(cache: PagedKVCache, slot):
+def gather_slot_strip(cache: PagedKVCache, slot):  # kfrm: disable=KFRM008
     """Debug/test helper: slot ``slot``'s logical strip as contiguous
     (k (L, S, KVH, hd), v, positions (S,)) arrays."""
     row = cache.block_tables[slot]

@@ -43,6 +43,8 @@ Accuracy and the speed claim are covered by ``tests/test_quantize.py``.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -110,6 +112,21 @@ def quantize_params(params: dict, bits: int = 8,
     return out
 
 
+@partial(jax.jit, static_argnames=("cfg", "name", "shape", "bits",
+                                   "group_size"))
+def _init_quant_leaf(key: jax.Array, cfg, name: str, shape: tuple,
+                     bits: int, group_size: int):
+    """Init one matmul leaf and quantize it inside a single jitted
+    call, so the full-precision tensor is a transient. Module-level
+    on purpose: the trace cache keys on the static (name, shape) —
+    one compile per distinct leaf spec across ALL calls, where the
+    old per-leaf ``jax.jit(lambda ...)`` built a fresh single-entry
+    cache every iteration (KFRM007)."""
+    from kubeflow_rm_tpu.models.llama import init_leaf
+
+    return _quant_fn(bits, group_size)(init_leaf(cfg, name, shape, key))
+
+
 def init_params_quantized(cfg, key: jax.Array, bits: int = 8,
                           group_size: int = 128) -> dict:
     """Random-init a model DIRECTLY into quantized form, one leaf at a
@@ -130,7 +147,6 @@ def init_params_quantized(cfg, key: jax.Array, bits: int = 8,
     """
     from kubeflow_rm_tpu.models.llama import init_leaf, param_spec_shapes
 
-    quant = _quant_fn(bits, group_size)
     # dispatch shapes like models.init_params does (MixtralConfig
     # reuses llama's init rules over its own shape tree)
     from kubeflow_rm_tpu.models.mixtral import MixtralConfig
@@ -148,9 +164,9 @@ def init_params_quantized(cfg, key: jax.Array, bits: int = 8,
     for (path, shape), k in zip(flat, keys):
         name = path[-1].key
         if name in _MATMUL_LEAVES or name == "lm_head":
-            fn = jax.jit(lambda kk, n=name, s=shape:
-                         quant(init_leaf(cfg, n, s, kk)))
-            leaves.append(jax.block_until_ready(fn(k)))
+            leaves.append(jax.block_until_ready(
+                _init_quant_leaf(k, cfg, name, tuple(shape),
+                                 bits, group_size)))
         else:
             leaves.append(init_leaf(cfg, name, shape, k))
     return jax.tree_util.tree_unflatten(treedef, leaves)

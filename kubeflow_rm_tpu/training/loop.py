@@ -26,6 +26,7 @@ from typing import Any, Callable, Iterable
 
 import jax
 
+from kubeflow_rm_tpu.analysis.jaxcheck import hostsync as _hostsync
 from kubeflow_rm_tpu.training.checkpoint import Checkpointer
 from kubeflow_rm_tpu.training.train import (
     TrainConfig, TrainState, init_train_state, make_train_step, shard_batch,
@@ -129,7 +130,11 @@ def fit(
     try:
         for i in range(start, total):
             dev_batch = shard_batch({k: batch[k] for k in batch_keys}, mesh)
-            state, metrics = step_fn(state, dev_batch)
+            # hot region: dispatch must stay async — the deliberate
+            # metric syncs below run OUTSIDE it (KFRM_HOSTSYNC_PROBE
+            # records any implicit sync in here as a witness)
+            with _hostsync.region("train.step"):
+                state, metrics = step_fn(state, dev_batch)
 
             now = i + 1
             if now == start + 1:

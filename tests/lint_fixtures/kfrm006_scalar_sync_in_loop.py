@@ -1,0 +1,35 @@
+"""Fixture: scalar host-syncs on jitted results inside a loop (the
+per-token decode-loop stall), plus the batched pattern that is fine."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("temperature",))
+def sample_row(logits, temperature):
+    return logits.argmax(axis=-1)
+
+
+step = jax.jit(lambda carry, tok: (carry + tok, carry))
+
+
+def decode_loop(logits_rows):
+    out = []
+    for row in logits_rows:
+        out.append(int(sample_row(row, temperature=0.0)))   # KFRM006
+    return out
+
+
+def metrics_loop(carry, tokens):
+    traces = []
+    for tok in tokens:
+        traces.append(np.asarray(step(carry, tok)))         # KFRM006
+    return traces
+
+
+def batched(logits_rows):
+    # the fix: keep results on device, sync once after the loop
+    out = [sample_row(row, temperature=0.0) for row in logits_rows]
+    return [int(x) for x in jax.device_get(out)]

@@ -1,0 +1,31 @@
+"""Fixture: jit construction inside a loop body (a fresh trace cache
+per iteration), plus the hoisted pattern that is fine."""
+
+from functools import partial
+
+import jax
+
+
+def requant_all(leaves):
+    out = []
+    for leaf in leaves:
+        fn = jax.jit(lambda x: x * 2)                       # KFRM007
+        out.append(fn(leaf))
+    return out
+
+
+def requant_batched(leaves):
+    i = 0
+    while i < len(leaves):
+        wrapped = partial(jax.jit, static_argnames=("n",))  # KFRM007
+        leaves[i] = wrapped(lambda x, n: x + n)(leaves[i], n=i)
+        i += 1
+    return leaves
+
+
+_scale = jax.jit(lambda x: x * 2)
+
+
+def hoisted(leaves):
+    # the fix: one jitted callable, constructed once at module scope
+    return [_scale(leaf) for leaf in leaves]

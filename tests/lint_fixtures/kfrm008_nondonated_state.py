@@ -1,0 +1,38 @@
+"""Fixture: jitted steps whose state/cache argument is not donated
+(the step double-buffers its largest allocation), plus the donated
+patterns that are fine."""
+
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def train_step(state, batch):                               # KFRM008
+    return state, batch
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decode_step(params, cfg, kv_cache, tokens):             # KFRM008
+    return tokens, kv_cache
+
+
+def make_step(opt):
+    def step(state, batch):
+        return state, batch
+
+    return jax.jit(step)                                    # KFRM008
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def donated_step(state, batch):
+    return state, batch
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def donated_decode(params, cfg, cache, tokens):
+    return tokens, cache
+
+
+make_jitted = jax.jit(lambda state, batch: (state, batch),
+                      donate_argnums=(0,))

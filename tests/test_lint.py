@@ -31,6 +31,9 @@ SEEDED = [
     ("kfrm003_acquire_no_finally.py", "KFRM003", {10}),
     ("kfrm004_write_under_lock.py", "KFRM004", {14}),
     ("kfrm005_silent_swallow.py", "KFRM005", {8}),
+    ("kfrm006_scalar_sync_in_loop.py", "KFRM006", {21, 28}),
+    ("kfrm007_jit_in_loop.py", "KFRM007", {12, 20}),
+    ("kfrm008_nondonated_state.py", "KFRM008", {11, 16, 24}),
 ]
 
 

@@ -1,0 +1,394 @@
+#!/usr/bin/env python3
+"""Seeded fault-matrix storm: chaos across every choke point at once,
+zero lost notebooks.
+
+The chaos engine (``controlplane/chaos.py``) injects faults one choke
+point at a time in unit tests; this harness is the integration claim —
+a FULL fault matrix armed simultaneously over the wall-clock socket
+stack (in-memory apiserver + admission + fake kubelet behind the REST
+facade, an elected controller manager over the kube adapter with watch
+threads), while a threaded client storm provisions a fleet of notebooks
+and drives suspend/resume cycles through the real lifecycle verbs:
+
+- ``reconcile_stall``    latency inside every controller's reconcile
+- ``api_error``          synthetic 503s on the kube adapter's verbs
+- ``api_timeout``        injected client timeouts on the same path
+- ``watch_drop``         lost watch events (surfaced as TOO_OLD gaps)
+- ``watch_dup``          duplicated watch deliveries
+- ``checkpoint_fail``    checkpoint-store write failures mid-suspend
+- ``pod_kill``           kubelet-level pod kills under running slices
+
+Every arm heals through the platform's OWN recovery ladders (requeue
+with backoff, relist on TOO_OLD, level-triggered convergence, slice
+restart, lifecycle retry) — no harness-side cleanup. The claims in the
+artifact (``CHAOS_r{N}.json``):
+
+- **zero lost notebooks**: every spawned notebook reaches full slice
+  readiness after the plan is uninstalled, none disappears;
+- **exactness through chaos**: every suspend→resume cycle restores the
+  checkpointed training step exactly, even with checkpoint writes
+  failing underneath;
+- **full attribution**: a fixed seed reproduces the fault mix; every
+  enabled fault kind fired ≥1× and is itemized (counts, opportunities,
+  ledger) in the artifact, with rate-limited flight-recorder bundles
+  per injected incident (``--flight-out``).
+
+``--no-chaos`` is the control arm for CI's perf ratchet: the identical
+storm with no plan installed, asserting zero injections — so latency
+baselines are never polluted by injected faults.
+
+Usage:
+    python conformance/chaos_conformance.py --out CHAOS_r01.json \\
+        --flight-out FLIGHT_ci.json
+    python conformance/chaos_conformance.py --no-chaos
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from kubeflow_rm_tpu.controlplane import (  # noqa: E402
+    WATCHED_KINDS, chaos, make_cluster_manager, metrics, suspend,
+)
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api import poddefault as pd_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api.notebook import (  # noqa: E402
+    make_notebook,
+)
+from kubeflow_rm_tpu.controlplane.api.profile import make_profile  # noqa: E402
+from kubeflow_rm_tpu.controlplane.apiserver import (  # noqa: E402
+    APIError, APIServer, Conflict,
+)
+from kubeflow_rm_tpu.controlplane.obs.flight import (  # noqa: E402
+    FlightRecorder,
+)
+from kubeflow_rm_tpu.controlplane.obs.runmeta import (  # noqa: E402
+    build_run_meta,
+)
+
+NS = "chaos"
+USER = "chaos@corp.com"
+ACCEL = "v5p-8"          # single-host slices: one node per notebook
+
+# transient surfaces of the armed plan (plus CAS races the storm's
+# threads cause on their own) — everything a client-side retry heals
+_TRANSIENT = (APIError, Conflict, TimeoutError, OSError)
+
+
+def _retry(fn, *, attempts=40, what="op"):
+    """Client-side retry loop: injected 503s/timeouts and checkpoint
+    write failures surface HERE (the harness is the client); a real
+    notebook user's SDK retries exactly like this."""
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except _TRANSIENT:
+            if attempt == attempts - 1:
+                raise
+            time.sleep(0.05)
+
+
+def default_plan(seed: int, flight) -> chaos.FaultPlan:
+    """The CI fault matrix: all seven one-process fault kinds armed at
+    once. Rates are tuned so high-opportunity sites (api verbs, watch
+    fanout, reconciles) fire a handful of times over the storm, while
+    low-opportunity sites (checkpoint writes, running-slice kills) are
+    near-certain per opportunity but capped so convergence is never
+    starved."""
+    return chaos.FaultPlan(seed, [
+        chaos.FaultSpec("reconcile_stall", rate=0.05, stall_ms=5.0),
+        chaos.FaultSpec("api_error", rate=0.03),
+        chaos.FaultSpec("api_timeout", rate=0.02),
+        chaos.FaultSpec("watch_drop", rate=0.03),
+        chaos.FaultSpec("watch_dup", rate=0.03),
+        chaos.FaultSpec("checkpoint_fail", rate=0.75, limit=2),
+        chaos.FaultSpec("pod_kill", rate=0.5, limit=2,
+                        match=f"{NS}/"),
+    ], flight=flight)
+
+
+def local_stack(stop, *, nodes: int):
+    """The e2e_walk local backend, storm-shaped: one elected-manager
+    process layout (apiserver + webhooks + fake kubelet + REST facade +
+    cluster manager over the kube adapter), suspend lifecycle on, no
+    idle culler, short SyncPeriod so dropped watch events heal in ~2s
+    instead of stalling a wait."""
+    from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+        DeploymentController, StatefulSetController, make_tpu_node,
+    )
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+    )
+    from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+    from kubeflow_rm_tpu.controlplane.runtime import Manager
+    from kubeflow_rm_tpu.controlplane.webhook.notebook import (
+        NotebookWebhook,
+    )
+    from kubeflow_rm_tpu.controlplane.webhook.poddefault import (
+        PodDefaultWebhook,
+    )
+    from kubeflow_rm_tpu.controlplane.webhook.tpu_inject import (
+        TpuInjectWebhook,
+    )
+
+    capi = APIServer()
+    capi.register_validator(nb_api.KIND, nb_api.validate)
+    capi.register_validator(pd_api.KIND, pd_api.validate)
+    capi.register_validator(tj_api.KIND, tj_api.validate)
+    NotebookWebhook(capi).register()
+    PodDefaultWebhook(capi).register()
+    TpuInjectWebhook(capi).register()
+    kubelet = Manager(capi)
+    kubelet.add(StatefulSetController(auto_ready=True))
+    kubelet.add(DeploymentController(auto_ready=True))
+    for i in range(nodes):
+        capi.create(make_tpu_node(f"{ACCEL}-n{i}", ACCEL))
+    rest = RestServer(capi)
+    rest.start()
+    threading.Thread(target=kubelet.run_forever, args=(stop, 0.05),
+                     kwargs={"resync_interval_s": 2.0},
+                     daemon=True).start()
+
+    mapi = KubeAPIServer(rest.url, identity="chaos-mgr")
+    mgr = make_cluster_manager(mapi, enable_culling=False,
+                               enable_suspend=True)
+    for kind in WATCHED_KINDS:
+        threading.Thread(target=mapi.watch_kind,
+                         args=(kind, None, stop, 60),
+                         daemon=True).start()
+    mgr.enqueue_all()
+    threading.Thread(target=mgr.run_forever, args=(stop, 0.05),
+                     kwargs={"workers": 8,
+                             "resync_interval_s": 2.0},
+                     daemon=True).start()
+    # the storm's own client: live (uncached) reads, so every harness
+    # verb crosses the injected request path like real user traffic
+    return KubeAPIServer(rest.url, identity="chaos-client"), rest
+
+
+class Storm:
+    def __init__(self, api, n: int):
+        self.api = api
+        self.n = n
+        self.hosts = tpu_api.lookup(ACCEL).hosts
+        self.names = [f"chaos-{i}" for i in range(n)]
+
+    def wait(self, cond, timeout=120, what="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = _retry(cond, what=what)
+            if v:
+                return v
+            time.sleep(0.05)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def ready(self, name: str) -> bool:
+        nb = self.api.try_get("Notebook", name, NS)
+        return bool(nb and (nb.get("status") or {}).get(
+            "readyReplicas") == self.hosts)
+
+    def onboard(self):
+        _retry(lambda: self.api.create(make_profile(NS, USER)),
+               what="profile create")
+        self.wait(lambda: self.api.try_get(
+            "RoleBinding", "namespaceAdmin", NS), what="profile ready")
+
+    def spawn(self):
+        """Threaded provision storm: every create crosses the injected
+        verb path; every readiness wait rides the chaos-laced watch and
+        reconcile machinery."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(name):
+            _retry(lambda: self.api.create(make_notebook(
+                name, NS, accelerator_type=ACCEL,
+                annotations={nb_api.CULLING_EXCLUDE_ANNOTATION:
+                             "true"})), what=f"create {name}")
+            self.wait(lambda name=name: self.ready(name),
+                      what=f"{name} ready under chaos")
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            list(pool.map(one, self.names))
+
+    def lifecycle_cycles(self, count: int) -> list[dict]:
+        """Suspend→resume cycles through the real verbs while the plan
+        is armed: checkpoint writes fail underneath (the injected
+        OSError surfaces to this client, which retries), drains race
+        stalled reconciles, and the restored step must still be EXACT.
+        Sequential on purpose: the checkpoint_fail stream then draws in
+        a deterministic order for a fixed seed."""
+        cycles = []
+        for i, name in enumerate(self.names[:count]):
+            step = str(10 + i)
+
+            def stamp(name=name, step=step):
+                nb = self.api.get("Notebook", name, NS)
+                nb["metadata"].setdefault("annotations", {})[
+                    nb_api.TRAINING_STEP_ANNOTATION] = step
+                self.api.update(nb)
+            _retry(stamp, what=f"stamp {name}")
+
+            _retry(lambda name=name: suspend.initiate_suspend(
+                self.api, self.api.get("Notebook", name, NS),
+                reason="api"), what=f"suspend {name}")
+            self.wait(lambda name=name: (
+                (self.api.get("Notebook", name, NS).get("status") or {})
+                .get("phase") == nb_api.SUSPENDED_PHASE),
+                what=f"{name} suspended")
+
+            _retry(lambda name=name: suspend.request_resume(
+                self.api, self.api.get("Notebook", name, NS),
+                source="api"), what=f"resume {name}")
+            self.wait(lambda name=name: self.ready(name),
+                      what=f"{name} resumed")
+            restored = self.wait(
+                lambda name=name: (self.api.get(
+                    "Notebook", name, NS)["metadata"]
+                    .get("annotations") or {}).get(
+                    nb_api.RESTORED_STEP_ANNOTATION),
+                what=f"{name} restored step")
+            assert restored == step, \
+                f"{name}: restored {restored} != checkpointed {step}"
+            cycles.append({"notebook": name, "step": int(step),
+                           "restored": int(restored)})
+        return cycles
+
+    def assert_zero_lost(self):
+        """After the plan is gone the fleet must converge whole: every
+        notebook still exists and reaches full slice readiness, every
+        slice runs with exactly ``hosts`` Running pods."""
+        for name in self.names:
+            self.wait(lambda name=name: self.ready(name),
+                      what=f"{name} ready post-chaos")
+        pods = _retry(lambda: self.api.list("Pod", NS))
+        by_nb: dict[str, int] = {}
+        for p in pods:
+            owner = (p["metadata"].get("labels") or {}).get(
+                nb_api.NOTEBOOK_NAME_LABEL)
+            if owner and deep_get(p, "status", "phase") == "Running":
+                by_nb[owner] = by_nb.get(owner, 0) + 1
+        for name in self.names:
+            assert by_nb.get(name) == self.hosts, \
+                f"{name}: {by_nb.get(name)} running pods != {self.hosts}"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=20260805,
+                    help="FaultPlan seed (fixed in CI for a "
+                         "reproducible fault mix)")
+    ap.add_argument("--notebooks", type=int, default=8)
+    ap.add_argument("--cycles", type=int, default=4,
+                    help="suspend->resume cycles driven under chaos")
+    ap.add_argument("--faults", default="",
+                    help="override the fault matrix "
+                         "(fault[:rate[:stall_ms]],... — see "
+                         "chaos.plan_from_args); default: all seven "
+                         "one-process kinds at CI rates")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="control arm: identical storm, no plan "
+                         "installed, zero injections asserted (keeps "
+                         "the perf ratchet unpolluted)")
+    ap.add_argument("--flight-out", default="",
+                    help="write the flight-recorder bundles (one per "
+                         "non-rate-limited injected incident) to this "
+                         "JSON file")
+    ap.add_argument("--out", default="",
+                    help="write the result JSON (CHAOS_r{N}.json)")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    injected_before = metrics.registry_value(
+        "chaos_faults_injected_total")
+    suspend.set_state_store(suspend.InMemoryStateStore())
+    stop = threading.Event()
+    api, rest = local_stack(stop, nodes=args.notebooks)
+    storm = Storm(api, args.notebooks)
+    flight = FlightRecorder(
+        min_interval_s=1.0,
+        run_meta=build_run_meta(
+            "chaos_conformance",
+            {"arm": "no-chaos" if args.no_chaos else "chaos",
+             "seed": args.seed, "notebooks": args.notebooks}))
+
+    plan = None
+    if not args.no_chaos:
+        plan = (chaos.plan_from_args(args.seed, args.faults,
+                                     flight=flight)
+                if args.faults else default_plan(args.seed, flight))
+        chaos.install(plan)
+    try:
+        storm.onboard()
+        storm.spawn()
+        cycles = storm.lifecycle_cycles(args.cycles)
+    finally:
+        plan = chaos.uninstall() or plan
+        stop_late = stop  # keep the stack up for convergence checks
+    storm.assert_zero_lost()
+    if plan is not None:
+        plan.flush_flight()
+    stop_late.set()
+
+    result: dict = {
+        "run_meta": flight.run_meta,
+        "arm": "no-chaos" if args.no_chaos else "chaos",
+        "seed": args.seed,
+        "accelerator": ACCEL,
+        "notebooks": args.notebooks,
+        "suspend_resume_cycles": cycles,
+        "zero_lost_notebooks": True,      # asserted above
+        "restored_steps_exact": True,     # asserted per cycle
+        "total_s": round(time.perf_counter() - t0, 2),
+    }
+    if args.no_chaos:
+        injected = metrics.registry_value(
+            "chaos_faults_injected_total") - injected_before
+        assert injected == 0, \
+            f"{injected} faults injected in the no-chaos arm"
+        result["faults"] = {}
+        result["injections_total"] = 0
+    else:
+        summary = plan.summary()
+        missing = [s.fault for s in plan.specs
+                   if summary["faults"].get(s.fault, 0) < 1]
+        assert not missing, \
+            f"fault kinds never fired: {missing} " \
+            f"(opportunities: {summary['opportunities']})"
+        result["faults"] = summary["faults"]
+        result["fault_opportunities"] = summary["opportunities"]
+        result["injections_total"] = sum(summary["faults"].values())
+        result["ledger"] = plan.ledger()
+        result["flight"] = {
+            "bundles": flight.triggered_total,
+            "suppressed_rate_limited": flight.suppressed_total,
+        }
+    if args.flight_out:
+        with open(args.flight_out, "w") as f:
+            json.dump({"run_meta": flight.run_meta,
+                       "bundles": flight.bundles(),
+                       "triggered_total": flight.triggered_total,
+                       "suppressed_total": flight.suppressed_total},
+                      f, indent=1, default=str)
+        result["flight_out"] = args.flight_out
+
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    print(f"CHAOS CONFORMANCE OK ({result['arm']}: "
+          f"{result['injections_total']} injections, "
+          f"0 lost notebooks)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

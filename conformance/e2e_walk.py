@@ -42,6 +42,10 @@ Scenarios (each emits ok/skip + wall ms into the JSON artifact):
                        (chips re-gang the waiter), a high-priority
                        resume preempts exactly one victim, the pinned
                        notebook is never chosen
+  replicated           R=2 kernel: kill the active slice mid-session —
+                       the parked CPU standby promotes by demand-resume
+                       during think-time; first-execute-after-failover
+                       p50 beats cold provision (329 ms) by >=10x
   multirole            TPUJob gang (learner slice + CPU actors) binds
                        all-or-nothing; every pod gets role rendezvous
                        env (TPU vars on chip pods only); an oversize
@@ -297,10 +301,25 @@ class Walk:
             if (p["metadata"].get("labels") or {}).get(
                 nb_api.NOTEBOOK_NAME_LABEL) == "walk"],
             what="culled pods drained")
+        # ... and the controller must have SEEN the park land in status
+        # (status.parked). The restart below then waits for the epoch
+        # bump: unparking zeroes readyReplicas in the SAME status write
+        # it increments restartEpoch, so a stale ready count carried
+        # across the restart can never satisfy nb_ready and hand
+        # slice_restart a half-drained slice
+        st = self.wait(lambda: (lambda s: s if s.get("parked") else
+                                None)((self.api.get(
+                                    "Notebook", "walk", NS)
+                                    .get("status")) or {}),
+                       what="parked status mirrored")
+        epoch0 = st.get("restartEpoch", 0)
         # restart for the following scenarios
         self.api.patch("Notebook", "walk", {"metadata": {"annotations": {
             nb_api.STOP_ANNOTATION: None,
             nb_api.CULLING_EXCLUDE_ANNOTATION: "true"}}}, NS)
+        self.wait(lambda: ((self.api.get("Notebook", "walk", NS)
+                            .get("status")) or {}).get(
+            "restartEpoch", 0) > epoch0, what="restart epoch bump")
         self.nb_ready("walk")
         last = (nb["metadata"]["annotations"] or {}).get(
             nb_api.LAST_ACTIVITY_ANNOTATION)
@@ -488,7 +507,22 @@ class Walk:
                     nb_api.CULLING_EXCLUDE_ANNOTATION: "true"}))
             if name != "ov-c":
                 self.nb_ready(name)
-        time.sleep(0.5)  # give ov-c every chance to (wrongly) bind
+
+        # deterministic negative check (formerly a 0.5s wall-clock
+        # sleep, which raced the gang binds): the scheduler must have
+        # actually CONSIDERED ov-c against the full fleet and refused
+        # it whole — every host pod carries a FailedScheduling event
+        # and stays unbound
+        def ovc_refused():
+            pods = [p for p in self.api.list("Pod", NS)
+                    if (p["metadata"].get("labels") or {}).get(
+                        nb_api.NOTEBOOK_NAME_LABEL) == "ov-c"]
+            return (len(pods) == self.hosts and all(
+                not deep_get(p, "spec", "nodeName")
+                and any(e["reason"] == "FailedScheduling"
+                        for e in self.api.events_for(p))
+                for p in pods))
+        self.wait(ovc_refused, what="ov-c refused whole (no rump)")
         pending = self.api.get("Notebook", "ov-c", NS)
         assert (pending.get("status") or {}).get(
             "readyReplicas", 0) == 0, "ov-c bound past a full fleet"
@@ -537,6 +571,95 @@ class Walk:
             what="oversub pods swept")
         return {"backfill_ms": backfill_ms, "resume_ms": resume_ms,
                 "victim": victims[0]}
+
+    def replicated(self):
+        """NotebookOS replicated kernels over the socket stack: R=2, the
+        active replica holds the slice while a parked CPU-only standby
+        keeps warm state through the checkpoint store. Kill the active's
+        slice mid-"session" and measure the user-visible wait at the
+        NEXT execute: the warm standby promotes by demand-resume during
+        think-time, so the first-execute-after-failover wait must beat
+        a cold 100-way provision (PROVISION_r11 p50 = 329 ms) by >=10x
+        at the median."""
+        from kubeflow_rm_tpu.controlplane.controllers.notebook import (
+            standby_name,
+        )
+
+        cold_provision_p50_ms = 329.0   # PROVISION_r11, 100-way storm
+        iterations, think_s = 5, 0.25
+        self.api.create(make_notebook(
+            "rep", NS, accelerator_type=ACCEL, image=self.image,
+            replicas=2,
+            annotations={nb_api.CULLING_EXCLUDE_ANNOTATION: "true",
+                         nb_api.TRAINING_STEP_ANNOTATION: "17"}))
+        self.nb_ready("rep")
+        # the standby fleet parks next to it (R-1 CPU kernels), the
+        # failover controller publishes the replica state machine, and
+        # the warm checkpoint seeds before any failure happens
+        self.wait(lambda: deep_get(
+            self.api.try_get("StatefulSet", standby_name("rep"), NS)
+            or {}, "spec", "replicas") == 1, what="standby fleet")
+        self.wait(lambda: (self.api.get("Notebook", "rep", NS)
+                           ["metadata"].get("annotations") or {}).get(
+            nb_api.WARM_CHECKPOINT_ANNOTATION),
+            what="warm checkpoint seeded")
+
+        def slice_pods():
+            return [p for p in self.api.list("Pod", NS)
+                    if (p["metadata"].get("labels") or {}).get(
+                        nb_api.NOTEBOOK_NAME_LABEL) == "rep"]
+
+        waits_ms, active = [], "0"
+        for i in range(iterations):
+            pods = self.wait(
+                lambda: (lambda c: c if len(c) == self.hosts and all(
+                    deep_get(p, "status", "phase") == "Running"
+                    for p in c) else None)(slice_pods()),
+                what=f"iter {i}: full active slice")
+            victim = pods[0]
+            victim["status"] = {"phase": "Failed"}
+            self.api.update_status(victim)
+            time.sleep(think_s)          # the user is typing
+            flipped = "1" if active == "0" else "0"
+            t0 = time.perf_counter()
+
+            def promoted(flipped=flipped):
+                nb = self.api.get("Notebook", "rep", NS)
+                ann = nb["metadata"].get("annotations") or {}
+                states = json.loads(
+                    ann.get(nb_api.REPLICA_STATES_ANNOTATION) or "{}")
+                return (ann.get(nb_api.ACTIVE_REPLICA_ANNOTATION)
+                        == flipped
+                        and states.get(flipped) == "active"
+                        and nb_api.RESUME_REQUESTED_ANNOTATION
+                        not in ann
+                        and (nb.get("status") or {}).get(
+                            "readyReplicas") == self.hosts)
+            self.wait(promoted, what=f"iter {i}: standby promoted")
+            waits_ms.append(round(
+                1e3 * (time.perf_counter() - t0), 1))
+            active = flipped
+        p50 = sorted(waits_ms)[len(waits_ms) // 2]
+        assert p50 * 10 <= cold_provision_p50_ms, (
+            f"first-execute-after-failover p50 {p50}ms not >=10x "
+            f"better than cold provision {cold_provision_p50_ms}ms")
+        ann = (self.api.get("Notebook", "rep", NS)["metadata"]
+               .get("annotations")) or {}
+        restored = ann.get(nb_api.RESTORED_STEP_ANNOTATION)
+        assert restored == "17", f"restored step {restored} != 17"
+        failovers = [e for e in self.api.events_for(
+            self.api.get("Notebook", "rep", NS))
+            if e["reason"] == "FailedOver"]
+        assert len(failovers) >= iterations, \
+            f"{len(failovers)} FailedOver events < {iterations}"
+        self.api.delete("Notebook", "rep", NS)
+        self.wait(lambda: not slice_pods(), what="rep slice swept")
+        return {"iterations": iterations,
+                "failover_waits_ms": waits_ms,
+                "first_execute_p50_ms": p50,
+                "cold_provision_p50_ms": cold_provision_p50_ms,
+                "speedup_vs_cold": round(
+                    cold_provision_p50_ms / max(p50, 0.1), 1)}
 
     def shard_chaos(self):
         """Kill-a-shard chaos over the REAL sharded process topology.
@@ -837,6 +960,10 @@ class Walk:
                  skip=None if k else
                  "needs the local backend (suspend controller + "
                  "pod-status control)")
+        self.run("replicated", self.replicated,
+                 skip=None if k else
+                 "needs the local backend (failover controller + "
+                 "pod-status control)")
         self.run("multirole", self.multirole,
                  skip=None if k else
                  "needs gang pod-status control (fake kubelet)")
@@ -898,7 +1025,11 @@ def local_backend(stop):
             capi.create(make_tpu_node(f"{ACCEL}-s{s}-h{h}", ACCEL))
     rest = RestServer(capi)
     rest.start()
+    # short SyncPeriod: the walk's waits assert convergence, so bound
+    # the staleness a lost watch event can cause to ~2s instead of "the
+    # next stream restart" (the ~1min stalls behind the old flakes)
     threading.Thread(target=kubelet.run_forever, args=(stop, 0.05),
+                     kwargs={"resync_interval_s": 2.0},
                      daemon=True).start()
     # the Lease namespace (deployment-wise: the manager's own ns)
     capi.ensure_namespace("kubeflow")
@@ -932,7 +1063,8 @@ def local_backend(stop):
                              daemon=True).start()
         mgr.enqueue_all()
         threading.Thread(target=mgr.run_forever, args=(mstop, 0.05),
-                         kwargs={"workers": 8, "elector": elector},
+                         kwargs={"workers": 8, "elector": elector,
+                                 "resync_interval_s": 2.0},
                          daemon=True).start()
         return {"identity": identity, "stop": mstop,
                 "elector": elector, "kapi": kapi}

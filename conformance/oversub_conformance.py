@@ -33,9 +33,16 @@ histogram and a chip-utilization-over-time series.
 Notebooks beyond the fleet stay Pending forever, nobody is ever
 suspended or preempted, and the harness asserts exactly that.
 
+``--migration`` is the fragmentation arm: a packed v6e fleet with free
+chips stranded across nodes rejects a whole-gang waiter under static
+placement, then admits it once fragmentation-triggered live migration
+(checkpoint -> drain -> re-bind elsewhere) defragments a node.
+
 Usage:
     python conformance/oversub_conformance.py --out OVERSUB_r01.json
     python conformance/oversub_conformance.py --no-oversubscribe
+    python conformance/oversub_conformance.py --migration \\
+        --slices v6e-4=3 --out OVERSUB_MIGRATION_r01.json
 """
 
 from __future__ import annotations
@@ -151,7 +158,9 @@ class Storm:
     def phases(self) -> dict[str, int]:
         out = {"ready": 0, "suspended": 0, "pending": 0}
         for name in self.names:
-            nb = self.api.get(nb_api.KIND, name, NS)
+            nb = self.api.try_get(nb_api.KIND, name, NS)
+            if nb is None:  # arm-specific fleets (e.g. --migration)
+                continue
             ann = annotations_of(nb)
             if deep_get(nb, "status", "readyReplicas",
                         default=0) == self.topo.hosts:
@@ -323,6 +332,140 @@ class Storm:
                 "notebook_preempt_total"),
         }
 
+    def run_migration(self) -> dict:
+        """--migration: fragmentation-triggered live migration admits a
+        gang that static placement rejects.
+
+        Six 1-chip kernels and one 4-chip kernel pack a 3-node v6e
+        fleet; suspending two smalls on DIFFERENT nodes strands enough
+        free chips in total (4) with no node holding the gang whole
+        (largest free run = 3). A 4-chip waiter then:
+
+        - static arm (auto-migration off): FailedScheduling forever —
+          the chips exist, placement can't use them;
+        - migration arm (auto-migration on): the compactor picks the
+          ONE victim whose chips defragment a node, checkpoints it,
+          re-binds it across the fleet, and the waiter admits. Exactly
+          one migration, zero chip overcommit throughout, and the
+          migrated kernel itself comes back with its step restored.
+        """
+        from kubeflow_rm_tpu.controlplane.api.notebook import (
+            make_notebook,
+        )
+
+        assert self.accel == "v6e-4" and self.slices == 3, \
+            "--migration expects --slices v6e-4=3"
+        api, mgr = self.api, self.mgr
+
+        def drive(name, ticks=30):
+            for _ in range(ticks):
+                if self.ready_hosts(name):
+                    return
+                self.check_overcommit()
+                self.clock.advance(minutes=1.0)
+                mgr.run_until_idle()
+            raise AssertionError(f"{name} never became ready")
+
+        # pack: s0-s3 fill node 0 (least-free-first + name tiebreak),
+        # the 4-chip big kernel fills node 1, s4-s5 land on node 2
+        smalls = [f"frag-s{i}" for i in range(6)]
+        for nm in smalls[:4]:
+            api.create(make_notebook(nm, NS, accelerator_type="v6e-1"))
+            mgr.run_until_idle()
+        api.create(make_notebook("frag-big", NS,
+                                 accelerator_type="v6e-4"))
+        mgr.run_until_idle()
+        for nm in smalls[4:]:
+            api.create(make_notebook(nm, NS, accelerator_type="v6e-1"))
+            mgr.run_until_idle()
+        for nm in smalls + ["frag-big"]:
+            drive(nm)
+
+        # strand chips across nodes: park one small on node 0 and one
+        # on node 2 through the real lifecycle verbs
+        for nm in ("frag-s0", "frag-s4"):
+            _update_annotations(
+                api, nm, lambda n: set_annotation(
+                    n, nb_api.TRAINING_STEP_ANNOTATION, "5"))
+            suspend.initiate_suspend(
+                api, api.get(nb_api.KIND, nm, NS), reason="api")
+            mgr.run_until_idle()
+            self.clock.advance(minutes=2.0)
+            mgr.run_until_idle()
+        st = scheduler.cache_for(mgr.api).stats()
+        assert st["free_chips"] >= 4.0, st
+        assert st["largest_free_gang"] < 4.0, st
+        assert st["fragmentation"] > 0, st
+
+        # static placement rejects the waiter: enough chips in total,
+        # no node holds the gang — FailedScheduling, zero rump
+        api.create(make_notebook("frag-waiter", NS,
+                                 accelerator_type="v6e-4"))
+        for _ in range(5):
+            self.clock.advance(minutes=1.0)
+            mgr.run_until_idle()
+        assert not self.ready_hosts("frag-waiter"), \
+            "static placement admitted the fragmented gang"
+        waiter_pods = [p for p in api.list("Pod", NS)
+                       if (p["metadata"].get("labels") or {}).get(
+                           nb_api.NOTEBOOK_NAME_LABEL) == "frag-waiter"]
+        assert waiter_pods and all(
+            not deep_get(p, "spec", "nodeName")
+            and any(e["reason"] == "FailedScheduling"
+                    for e in api.events_for(p))
+            for p in waiter_pods), "waiter not refused whole"
+        static_stats = {k: st[k] for k in
+                        ("free_chips", "largest_free_gang",
+                         "fragmentation")}
+
+        # flip auto-migration on: the SAME fleet, the SAME waiter
+        suspend.set_auto_migration(True)
+        try:
+            mgr.enqueue_all()
+            drive("frag-waiter")
+        finally:
+            suspend.set_auto_migration(False)
+        self.check_overcommit()
+        migs = metrics.registry_value(
+            "notebook_migration_total", {"trigger": "fragmentation"})
+        assert migs == 1, f"expected exactly one migration, got {migs}"
+        movable = smalls[1:4] + smalls[5:] + ["frag-big"]
+        migrated = [nm for nm in movable if any(
+            e["reason"] == "Migrated"
+            for e in api.events_for(api.get(nb_api.KIND, nm, NS)))]
+        assert len(migrated) == 1, f"migrated: {migrated}"
+        drive(migrated[0])  # the displaced kernel itself recovered
+        restored = annotations_of(api.get(
+            nb_api.KIND, migrated[0], NS)).get(
+            nb_api.RESTORED_STEP_ANNOTATION)
+        assert restored is not None, \
+            f"{migrated[0]} re-bound without a checkpoint restore"
+        return {
+            "suspend_resume_ms": {"count": 0},
+            "progress_steps": {},
+            "resumes_observed": 0,
+            "static_arm": {**static_stats,
+                           "waiter_admitted": False},
+            "migration_arm": {"waiter_admitted": True,
+                              "migrated": migrated[0],
+                              "migrations_total": migs,
+                              "restored_step": restored},
+            "suspends_total": metrics.registry_value(
+                "notebook_suspend_total"),
+            "preemptions_total": metrics.registry_value(
+                "notebook_preempt_total"),
+        }
+
+    def ready_hosts(self, name: str) -> bool:
+        """Readiness against the notebook's OWN topology (the migration
+        fleet mixes 1-chip and 4-chip types; ``ready()`` assumes the
+        storm's single type)."""
+        nb = self.api.get(nb_api.KIND, name, NS)
+        accel = deep_get(nb, "spec", "tpu", "acceleratorType")
+        hosts = tpu_api.lookup(accel).hosts if accel else 1
+        return deep_get(nb, "status", "readyReplicas",
+                        default=0) == hosts
+
     def run_baseline(self) -> dict:
         """--no-oversubscribe: pin-for-lifetime preserved. The fleet
         admits exactly its capacity, the overflow stays Pending whole,
@@ -371,22 +514,34 @@ def main() -> int:
                     help="A/B baseline arm: pin-for-lifetime — no idle "
                          "suspension, no preemption; overflow notebooks "
                          "stay Pending")
+    ap.add_argument("--migration", action="store_true",
+                    help="fragmentation arm: prove auto live-migration "
+                         "admits a gang static placement rejects "
+                         "(expects --slices v6e-4=3)")
     ap.add_argument("--out", default="",
                     help="also write the result JSON to this file "
                          "(OVERSUB_r{N}.json artifact)")
     args = ap.parse_args()
 
     t0 = time.perf_counter()
+    if args.migration:
+        # explicit lifecycle verbs drive every suspend in this arm; a
+        # huge idle window keeps the fake-clock ticks from idle-parking
+        # the packed fleet mid-scenario
+        args.idle_minutes = 1e6
     storm = Storm(args)
-    if args.no_oversubscribe:
+    if args.migration:
+        detail = storm.run_migration()
+    elif args.no_oversubscribe:
         detail = storm.run_baseline()
     else:
         detail = storm.run_oversubscribed()
     storm.sample("final")
 
     result = {
-        "arm": "no-oversubscribe" if args.no_oversubscribe
-               else "oversubscribe",
+        "arm": ("migration" if args.migration
+                else "no-oversubscribe" if args.no_oversubscribe
+                else "oversubscribe"),
         "slice": storm.accel,
         "fleet_slices": storm.slices,
         "hosts_per_slice": storm.topo.hosts,

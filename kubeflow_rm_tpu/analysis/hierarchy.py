@@ -41,6 +41,11 @@ LOCK_HIERARCHY: dict[str, int] = {
     "scheduler.nodes_map": 40,      # node-map membership
     "scheduler.node": 50,           # ranked family: sorted by node name
     "scheduler.pods_map": 60,       # pod -> entry accounting map
+    # suspend's per-notebook checkpoint guard is held across the state-
+    # store call AND its annotation CAS, so it must sit below every
+    # apiserver verb lock; the registry hands out the per-key instances
+    "suspend.store_registry": 70,
+    "suspend.store": 80,            # ranked family: by "ns/name" key
     # -- apiserver write path ------------------------------------------
     "apiserver.kind": 110,          # per-kind verb locks (DAG inside)
     "apiserver.kind_locks_map": 120,
@@ -52,6 +57,11 @@ LOCK_HIERARCHY: dict[str, int] = {
     "apiserver.rv": 145,            # atomic resourceVersion counter
     "apiserver.admission_pool": 150,
     "apiserver.watch_channel": 160,  # per-watcher fanout condvar
+    # chaos.plan is taken from inside publish (under watch_channel) and
+    # from the kubeclient request path; it never takes anything while
+    # held (flight triggers are deferred), so it slots just above the
+    # deepest lock that calls into it
+    "chaos.plan": 165,              # fault-plan draw/ledger mutex
     # -- controller runtime / HA ---------------------------------------
     "runtime.queue": 210,
     "runtime.child_pool": 220,

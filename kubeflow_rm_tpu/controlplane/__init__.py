@@ -112,8 +112,16 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     if enable_culling:
         manager.add(CullingController(**(culler_config or {})))
     if enable_suspend:
-        from kubeflow_rm_tpu.controlplane.suspend import SuspendController
+        from kubeflow_rm_tpu.controlplane.suspend import (
+            ReplicaFailoverController,
+            SuspendController,
+        )
         manager.add(SuspendController(**(suspend_config or {})))
+        # replicated kernels ride the same suspend/resume primitive:
+        # failover = demand-resume from the warm checkpoint, so the
+        # controller ships (and shares a store) with the lifecycle
+        manager.add(ReplicaFailoverController(
+            store=(suspend_config or {}).get("store")))
     return api, manager
 
 
@@ -178,8 +186,13 @@ def make_cluster_manager(api, *, enable_culling: bool = True,
     if enable_culling:
         manager.add(CullingController(**(culler_config or {})))
     if enable_suspend:
-        from kubeflow_rm_tpu.controlplane.suspend import SuspendController
+        from kubeflow_rm_tpu.controlplane.suspend import (
+            ReplicaFailoverController,
+            SuspendController,
+        )
         manager.add(SuspendController(**(suspend_config or {})))
+        manager.add(ReplicaFailoverController(
+            store=(suspend_config or {}).get("store")))
     return manager
 
 

@@ -67,6 +67,29 @@ TRAINING_STEP_ANNOTATION = "notebooks.kubeflow.org/training-step"
 # pin: never suspend, never select as a preemption victim, never cull
 PIN_ANNOTATION = "tpu.kubeflow.org/do-not-suspend"
 
+# --- replicated kernels (NotebookOS): spec.replicas standbys ----------
+# With ``spec.replicas: R`` > 1 one replica is *active* (holds the
+# chips); R-1 are parked CPU-only standbys kept warm through the
+# checkpoint state store. The failover controller owns these:
+# which replica id currently holds the chips (int as str)
+ACTIVE_REPLICA_ANNOTATION = "tpu.kubeflow.org/active-replica"
+# JSON {replica_id: "active" | "standby" | "promoting"}
+REPLICA_STATES_ANNOTATION = "tpu.kubeflow.org/replica-states"
+# JSON checkpoint token standbys keep warm (refreshed as the active
+# replica's durable training step advances — what a promotion restores)
+WARM_CHECKPOINT_ANNOTATION = "tpu.kubeflow.org/warm-checkpoint"
+# ISO timestamp the active replica's death was detected (failover
+# latency clock; popped when the promotion completes)
+FAILOVER_T0_ANNOTATION = "tpu.kubeflow.org/failover-t0"
+
+# --- live migration (checkpoint -> drain -> re-bind elsewhere) --------
+# JSON list of node names the rebind must avoid (the nodes the slice
+# occupied when the migration was initiated)
+MIGRATE_EXCLUDE_ANNOTATION = "tpu.kubeflow.org/migrate-exclude-nodes"
+# ISO timestamp of the migration request; while present the drain
+# auto-resumes instead of parking (popped when the re-bind completes)
+MIGRATE_REQUESTED_ANNOTATION = "tpu.kubeflow.org/migrate-requested"
+
 #: the lifecycle phase a drained suspended notebook reports
 SUSPENDED_PHASE = "Suspended"
 
@@ -90,6 +113,7 @@ def make_notebook(name: str, namespace: str, *,
                   accelerator_type: str | None = None,
                   num_slices: int = 1,
                   priority_class: str | None = None,
+                  replicas: int | None = None,
                   labels: dict | None = None,
                   annotations: dict | None = None,
                   pod_spec_extra: dict | None = None,
@@ -113,6 +137,8 @@ def make_notebook(name: str, namespace: str, *,
             spec["tpu"]["numSlices"] = num_slices
     if priority_class is not None:
         spec["priorityClassName"] = priority_class
+    if replicas is not None:
+        spec["replicas"] = replicas
     return make_object(API_VERSION, KIND, name, namespace,
                        labels=labels, annotations=annotations, spec=spec)
 
@@ -143,6 +169,22 @@ def total_hosts(notebook: dict) -> int:
     if topo is None:
         return 1
     return topo.hosts * num_slices(notebook)
+
+
+#: schema-level cap on kernel replication width — each extra replica is
+#: one parked CPU-only standby pod; past a handful the marginal
+#: availability gain is zero while the pod fan-out is linear
+MAX_REPLICAS = 8
+
+
+def replicas_of(notebook: dict) -> int:
+    """Scheduling-replica count (NotebookOS ``R``): 1 means the classic
+    single-kernel notebook; R > 1 keeps R-1 warm CPU standbys."""
+    try:
+        return max(1, int(deep_get(notebook, "spec", "replicas",
+                                   default=1)))
+    except (TypeError, ValueError):
+        return 1
 
 
 def priority_of(notebook: dict) -> int:
@@ -195,3 +237,8 @@ def validate(notebook: dict) -> None:
     p = deep_get(notebook, "spec", "priority")
     if p is not None and not isinstance(p, int):
         raise ValueError("spec.priority must be an integer")
+    r = deep_get(notebook, "spec", "replicas")
+    if r is not None and (not isinstance(r, int) or r < 1
+                          or r > MAX_REPLICAS):
+        raise ValueError(
+            f"spec.replicas must be an int in [1, {MAX_REPLICAS}]")

@@ -36,7 +36,7 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     parse_quantity,
     strategic_merge,
 )
-from kubeflow_rm_tpu.controlplane import tracing
+from kubeflow_rm_tpu.controlplane import chaos, tracing
 from kubeflow_rm_tpu.analysis.lockgraph import (
     make_condition,
     make_lock,
@@ -167,9 +167,29 @@ class _WatcherChannel:
         self._m_lag = metrics.WATCH_FANOUT_DISPATCH_LAG.labels(
             watcher=name)
 
+    def _chaos_item(self, item: tuple) -> list[tuple]:
+        """Chaos-engine watch faults (no-op without an installed plan):
+        a *drop* substitutes the channel's own ``TOO_OLD`` gap sentinel
+        — the watch contract is "ordered window or detectable gap", so
+        a lost event manifests as the gap and the watcher relists; a
+        *dup* delivers the item twice (idempotency probe). The verdict
+        is drawn before the channel lock; injected sentinels follow the
+        normal overflow path."""
+        verdict = chaos.watch_fault(self.name, item[0])
+        if verdict is None:
+            return [item]
+        if verdict == "drop":
+            self.overflows += 1
+            self._m_overflow.inc()
+            return [(TOO_OLD, {}, None, time.monotonic())]
+        return [item, item]  # dup
+
     def publish(self, item: tuple) -> None:
+        items = [item]
+        if chaos.active() is not None:
+            items = self._chaos_item(item)
         with self._cond:
-            if len(self._q) >= self.maxlen:
+            if len(self._q) + len(items) > self.maxlen:
                 # drop the whole window: partial delivery after a gap
                 # would be indistinguishable from ordered delivery
                 self._q.clear()
@@ -177,7 +197,7 @@ class _WatcherChannel:
                 self._m_overflow.inc()
                 self._q.append((TOO_OLD, {}, None, time.monotonic()))
             else:
-                self._q.append(item)
+                self._q.extend(items)
             self._m_depth.set(len(self._q))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
@@ -194,6 +214,8 @@ class _WatcherChannel:
         single ``TOO_OLD`` exactly like ``publish``."""
         if not items:
             return
+        if chaos.active() is not None:
+            items = [out for it in items for out in self._chaos_item(it)]
         with self._cond:
             if len(self._q) + len(items) > self.maxlen:
                 self._q.clear()

@@ -54,6 +54,17 @@ def headless_name(notebook_name: str) -> str:
     return f"{notebook_name}-workers"
 
 
+def standby_name(notebook_name: str) -> str:
+    return f"{notebook_name}-standby"
+
+
+# label carried by standby pods INSTEAD of NOTEBOOK_NAME_LABEL: the
+# drain/slice-health/failover machinery counts gang pods by the
+# notebook-name label, and a CPU standby must never be mistaken for a
+# slice member
+STANDBY_LABEL = "notebook-standby"
+
+
 class NotebookController(Controller):
     kind = nb_api.KIND
 
@@ -82,8 +93,15 @@ class NotebookController(Controller):
 
         with self._observe("render"):
             topo = nb_api.tpu_spec(notebook)
-            sts = self._generate_statefulset(notebook, topo)
+            parked, deferring = self._parked_state(api, notebook)
+            sts = self._generate_statefulset(notebook, topo,
+                                             parked=parked or deferring)
             children = [(sts, copy_statefulset_fields)]
+            replicas = nb_api.replicas_of(notebook)
+            if replicas > 1:
+                children.append((
+                    self._generate_standby_statefulset(notebook, replicas),
+                    copy_statefulset_fields))
             children += [(svc, copy_service_fields)
                          for svc in self._generate_services(notebook, topo)]
             if self.use_istio:
@@ -106,16 +124,51 @@ class NotebookController(Controller):
             raise
         if creating:
             metrics.NOTEBOOK_CREATE_TOTAL.inc()
+        if nb_api.replicas_of(notebook) <= 1:
+            # replicas collapsed back to 1: retire the standby fleet
+            standby = api.try_get("StatefulSet",
+                                  standby_name(req.name), req.namespace)
+            if standby is not None:
+                api.delete("StatefulSet", standby_name(req.name),
+                           req.namespace)
 
         with self._observe("status"):
-            self._mirror_status(api, notebook, topo)
+            self._mirror_status(api, notebook, topo,
+                                parked=parked, deferring=deferring)
         with self._observe("events"):
             self._reemit_pod_events(api, notebook)
         return None
 
     # -- rendering -----------------------------------------------------
+    def _parked_state(self, api: APIServer,
+                      notebook: dict) -> tuple[bool, bool]:
+        """(parked, deferring): parked = user-stopped OR suspended
+        (chips released to the pool) — renders to zero replicas; the
+        difference is who brings them back (a user vs. any incoming
+        request). deferring = the park was just lifted but the OLD
+        epoch's pods are still draining: the slice stays at zero until
+        they are gone, so a restart can never interleave fresh ordinals
+        with half-drained ones (the slice-health controller would read
+        that mix as a rump slice and churn-restart it)."""
+        ann = annotations_of(notebook)
+        parked = (nb_api.STOP_ANNOTATION in ann
+                  or nb_api.SUSPEND_ANNOTATION in ann)
+        deferring = False
+        if not parked and deep_get(notebook, "status", "parked",
+                                   default=False):
+            name = name_of(notebook)
+            ns = notebook["metadata"]["namespace"]
+            owned = [
+                p for p in getattr(api, "scan", api.list)("Pod", ns)
+                if (p["metadata"].get("labels") or {}).get(
+                    nb_api.NOTEBOOK_NAME_LABEL) == name
+            ]
+            deferring = bool(owned)
+        return parked, deferring
+
     def _generate_statefulset(self, notebook: dict,
-                              topo: tpu_api.SliceTopology | None) -> dict:
+                              topo: tpu_api.SliceTopology | None, *,
+                              parked: bool) -> dict:
         name = name_of(notebook)
         ns = notebook["metadata"]["namespace"]
         # multislice: one StatefulSet spans every slice (slice_id =
@@ -123,11 +176,6 @@ class NotebookController(Controller):
         # rendezvous + MEGASCALE_* DCN env from the labels below
         hosts = nb_api.total_hosts(notebook)
         ann = annotations_of(notebook)
-        # parked = user-stopped OR suspended (chips released to the
-        # pool): both render to zero replicas; the difference is who
-        # brings them back (a user vs. any incoming request)
-        parked = (nb_api.STOP_ANNOTATION in ann
-                  or nb_api.SUSPEND_ANNOTATION in ann)
         replicas = 0 if parked else hosts
 
         pod_spec = fast_deepcopy(
@@ -157,7 +205,22 @@ class NotebookController(Controller):
                 limits[tpu_api.GOOGLE_TPU_RESOURCE] = str(topo.chips_per_host)
             sel = pod_spec.setdefault("nodeSelector", {})
             sel[tpu_api.NODE_LABEL_ACCELERATOR] = topo.gke_accelerator
-            sel[tpu_api.NODE_LABEL_TOPOLOGY] = topo.topology
+            if topo.multihost:
+                # multi-host slices need the exact ICI topology
+                sel[tpu_api.NODE_LABEL_TOPOLOGY] = topo.topology
+            # single-host slices select on accelerator family only: a
+            # v6e-1 kernel packs onto any free v6e host regardless of
+            # the node pool's nominal topology, which is what lets the
+            # scheduler bin-pack small kernels and the compaction
+            # migrator defragment them
+
+        sts_annotations: dict = {}
+        if nb_api.MIGRATE_EXCLUDE_ANNOTATION in ann:
+            # live migration: the re-bind must avoid the nodes the
+            # slice just drained off; the STS controller reads this
+            # through to gang_bind(exclude_nodes=...)
+            sts_annotations[nb_api.MIGRATE_EXCLUDE_ANNOTATION] = \
+                ann[nb_api.MIGRATE_EXCLUDE_ANNOTATION]
 
         return {
             "apiVersion": "apps/v1",
@@ -166,6 +229,8 @@ class NotebookController(Controller):
                 "name": name,
                 "namespace": ns,
                 "labels": {nb_api.NOTEBOOK_NAME_LABEL: name},
+                **({"annotations": sts_annotations}
+                   if sts_annotations else {}),
             },
             "spec": {
                 "replicas": replicas,
@@ -176,6 +241,57 @@ class NotebookController(Controller):
                 "template": {
                     "metadata": {"labels": pod_labels,
                                  "annotations": pod_annotations},
+                    "spec": pod_spec,
+                },
+            },
+        }
+
+    def _generate_standby_statefulset(self, notebook: dict,
+                                      replicas: int) -> dict:
+        """R−1 parked CPU-only standby kernels (NotebookOS replication).
+
+        Standbys hold NO chips: no TPU resource limits, no TPU node
+        selector — they bind anywhere (or virtually) and stay warm
+        purely through the checkpoint state store, which is what makes
+        R−1 extra replicas nearly free. They deliberately do NOT carry
+        ``NOTEBOOK_NAME_LABEL``: every gang-membership scan (drain
+        completion, slice health, failover death detection) counts
+        pods by that label, and a standby is not a slice member."""
+        name = name_of(notebook)
+        ns = notebook["metadata"]["namespace"]
+        ann = annotations_of(notebook)
+        sname = standby_name(name)
+        pod_spec = fast_deepcopy(
+            deep_get(notebook, "spec", "template", "spec", default={}))
+        pod_spec.pop("nodeSelector", None)
+        for c in pod_spec.get("containers") or []:
+            limits = deep_get(c, "resources", "limits")
+            if limits:
+                limits.pop(tpu_api.GOOGLE_TPU_RESOURCE, None)
+        containers = pod_spec.get("containers") or []
+        if containers:
+            env = containers[0].setdefault("env", [])
+            _upsert_env(env, "NB_PREFIX", f"/notebook/{ns}/{name}")
+            _upsert_env(env, "NB_STANDBY", "1")
+        count = 0 if nb_api.STOP_ANNOTATION in ann else replicas - 1
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": sname,
+                "namespace": ns,
+                "labels": {STANDBY_LABEL: name},
+            },
+            "spec": {
+                "replicas": count,
+                "serviceName": headless_name(name),
+                "podManagementPolicy": "Parallel",
+                "selector": {"matchLabels": {"statefulset": sname}},
+                "template": {
+                    "metadata": {"labels": {
+                        "statefulset": sname,
+                        STANDBY_LABEL: name,
+                    }},
                     "spec": pod_spec,
                 },
             },
@@ -263,22 +379,48 @@ class NotebookController(Controller):
 
     # -- status --------------------------------------------------------
     def _mirror_status(self, api: APIServer, notebook: dict,
-                       topo: tpu_api.SliceTopology | None) -> None:
+                       topo: tpu_api.SliceTopology | None, *,
+                       parked: bool, deferring: bool) -> None:
         name, ns = name_of(notebook), notebook["metadata"]["namespace"]
         hosts = nb_api.total_hosts(notebook)
         sts = api.try_get("StatefulSet", name, ns)
         ready = deep_get(sts, "status", "readyReplicas", default=0) if sts \
             else 0
         ann = annotations_of(notebook)
-        parked = (nb_api.STOP_ANNOTATION in ann
-                  or nb_api.SUSPEND_ANNOTATION in ann)
+        effective_parked = parked or deferring
+        epoch = int(deep_get(notebook, "status", "restartEpoch",
+                             default=0))
+        prev_parked = bool(deep_get(notebook, "status", "parked",
+                                    default=False))
+        if prev_parked and not effective_parked:
+            # the park fully lifted (old pods drained): this status
+            # write starts a NEW epoch AND zeroes readyReplicas in the
+            # same write — a watcher waiting on the restart must never
+            # see the previous epoch's stale ready count
+            epoch += 1
+            ready = 0
         status: dict = {
             "readyReplicas": ready,
-            "desiredReplicas": 0 if parked else hosts,
+            "desiredReplicas": 0 if effective_parked else hosts,
+            "parked": effective_parked,
+            "restartEpoch": epoch,
         }
         if (nb_api.SUSPEND_ANNOTATION in ann
                 and nb_api.SUSPEND_DRAINED_ANNOTATION in ann):
             status["phase"] = nb_api.SUSPENDED_PHASE
+        replicas = nb_api.replicas_of(notebook)
+        if replicas > 1:
+            status["replicas"] = replicas
+            active = ann.get(nb_api.ACTIVE_REPLICA_ANNOTATION)
+            if active is not None:
+                status["activeReplica"] = active
+            raw_states = ann.get(nb_api.REPLICA_STATES_ANNOTATION)
+            if raw_states:
+                import json as _json
+                try:
+                    status["replicaStates"] = _json.loads(raw_states)
+                except ValueError:
+                    pass
         pod0 = api.try_get("Pod", f"{name}-0", ns)
         if pod0:
             cs = deep_get(pod0, "status", "containerStatuses", 0)

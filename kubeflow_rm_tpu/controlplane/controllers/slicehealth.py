@@ -48,9 +48,16 @@ class SliceHealthController(Controller):
             nb = api.get(self.kind, req.name, req.namespace)
         except NotFound:
             return None
-        if nb_api.STOP_ANNOTATION in (
-                nb["metadata"].get("annotations") or {}):
+        ann = nb["metadata"].get("annotations") or {}
+        if nb_api.STOP_ANNOTATION in ann:
             return None  # stopped/culled: drained pods are expected
+        if (nb_api.SUSPEND_ANNOTATION in ann
+                or nb_api.RESUME_REQUESTED_ANNOTATION in ann):
+            return None  # suspend/resume drains on purpose mid-flight
+        if nb_api.replicas_of(nb) > 1:
+            # replicated kernels: the failover controller owns recovery
+            # (promote a warm standby), not a cold in-place restart
+            return None
 
         # a multislice job is ONE gang: any slice's failure restarts all
         hosts = nb_api.total_hosts(nb)

@@ -18,6 +18,7 @@ gating) is exactly what GKE does to a real TPU-slice StatefulSet.
 from __future__ import annotations
 
 import copy
+import json
 
 from kubeflow_rm_tpu.controlplane.api.meta import (
     fast_deepcopy,
@@ -34,7 +35,7 @@ from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
 from kubeflow_rm_tpu.controlplane.apiserver import (
     AdmissionDenied, APIServer, NotFound, is_status,
 )
-from kubeflow_rm_tpu.controlplane import runtime, scheduler
+from kubeflow_rm_tpu.controlplane import chaos, runtime, scheduler
 from kubeflow_rm_tpu.controlplane.runtime import (
     Controller, Request, map_all_in_namespace, map_to_owner,
     phase_observer,
@@ -153,6 +154,7 @@ class StatefulSetController(Controller):
             if missing:
                 self._create_missing(api, sts, missing)
             self._schedule_and_run(api, sts)
+        self._maybe_chaos_pod_kill(api, sts)
         with self._observe("status"):
             self._mirror_status(api, sts)
             from kubeflow_rm_tpu.controlplane import metrics
@@ -300,6 +302,24 @@ class StatefulSetController(Controller):
                 # in-memory yes, real cluster no
                 else isinstance(getattr(api, "api", api), APIServer))
 
+    @staticmethod
+    def _exclude_nodes(sts: dict) -> set[str] | None:
+        """Live migration: the notebook controller mirrors the CR's
+        migrate-exclude annotation onto the STS; the re-bind must avoid
+        those nodes or the "migration" would land right back where it
+        drained from."""
+        from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+        raw = (sts["metadata"].get("annotations") or {}).get(
+            nb_api.MIGRATE_EXCLUDE_ANNOTATION)
+        if not raw:
+            return None
+        try:
+            nodes = json.loads(raw)
+        except ValueError:
+            return None
+        return {str(n) for n in nodes} if isinstance(nodes, list) \
+            else None
+
     def _mark_unschedulable(self, api: APIServer, pod: dict,
                             message: str | None = None) -> None:
         if deep_get(pod, "status", "phase") != "Pending":
@@ -341,7 +361,9 @@ class StatefulSetController(Controller):
         if not unbound:
             return
         allow_virtual = self._allow_virtual(api)
-        plan = sched.gang_bind(unbound, allow_virtual=allow_virtual)
+        exclude = self._exclude_nodes(sts)
+        plan = sched.gang_bind(unbound, allow_virtual=allow_virtual,
+                               exclude_nodes=exclude)
         if plan is None:
             # priority preemption: suspend strictly lower-priority
             # victim slices and retry the gang in this same reconcile
@@ -349,6 +371,12 @@ class StatefulSetController(Controller):
             plan = suspend.try_preempt(api, sts, unbound, sched,
                                        allow_virtual=allow_virtual)
         if plan is None:
+            # fragmentation-triggered live migration: when free chips
+            # would seat the gang but sit stranded across nodes, move a
+            # small victim out of the way (no-op unless enabled)
+            from kubeflow_rm_tpu.controlplane import suspend
+            suspend.try_compact_migration(api, sts, unbound, sched,
+                                          allow_virtual=allow_virtual)
             for pod in unbound:
                 self._mark_unschedulable(api, pod)
             return
@@ -451,6 +479,32 @@ class StatefulSetController(Controller):
             api.update(pod)
             if self.auto_ready:
                 self.mark_running(api, pod)
+
+    def _maybe_chaos_pod_kill(self, api: APIServer, sts: dict) -> None:
+        """Seeded kubelet pod-kill: one chaos opportunity per reconcile
+        of an STS with Running pods. The victim goes to phase=Failed —
+        exactly what a real kubelet reports for an OOM-killed or
+        node-lost container — so the platform's own recovery ladders
+        (slice health restart, replica failover) do the healing."""
+        if chaos.active() is None:
+            return
+        running = [p for p in self._owned_pods(api, sts)
+                   if deep_get(p, "status", "phase") == "Running"]
+        site = f"{namespace_of(sts)}/{name_of(sts)}"
+        victim = chaos.pod_kill_victim(site,
+                                       [name_of(p) for p in running])
+        if victim is None:
+            return
+        pod = next(p for p in running if name_of(p) == victim)
+        pod["status"]["phase"] = "Failed"
+        pod["status"]["conditions"] = [
+            {"type": "Ready", "status": "False"}]
+        try:
+            api.update_status(pod)
+            api.record_event(pod, "Warning", "ChaosKilled",
+                             "chaos: injected kubelet pod kill")
+        except NotFound:
+            pass  # raced a delete; the kill is moot
 
     def mark_running(self, api: APIServer, pod: dict,
                      live: dict | None = None) -> None:

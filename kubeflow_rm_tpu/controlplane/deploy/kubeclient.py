@@ -45,7 +45,7 @@ from kubeflow_rm_tpu.controlplane.apiserver import (
     NotFound,
     status_from_error,
 )
-from kubeflow_rm_tpu.controlplane import metrics, tracing
+from kubeflow_rm_tpu.controlplane import chaos, metrics, tracing
 from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 log = logging.getLogger("kubeflow_rm_tpu.kubeclient")
@@ -331,6 +331,15 @@ class _FastSession:
         tp = tracing.current_traceparent()
         if tp is not None:
             hdrs.setdefault(tracing.TRACE_HEADER, tp)
+        if not stream and chaos.active() is not None:
+            # seeded apiserver-fault injection: the same choke point
+            # that carries the trace header covers every verb of every
+            # session, so an injected timeout (raises) or 5xx (synthetic
+            # 503 the normal _raise_for path turns into APIError) hits
+            # exactly where a real overloaded shard would
+            injected = chaos.api_request_fault(method, path)
+            if injected is not None:
+                return injected
         if stream:
             conn = self._connect(timeout or 310)
             conn.request(method, path, body=body, headers=hdrs)

@@ -455,6 +455,48 @@ SHARD_DEATHS_TOTAL = Counter(
     registry=REGISTRY,
 )
 
+# ---- chaos engine + replicated kernels + migration -------------------
+CHAOS_FAULTS_INJECTED_TOTAL = Counter(
+    "chaos_faults_injected_total",
+    "Faults injected by the seeded chaos engine, by fault kind — the "
+    "attribution counter every chaos-matrix artifact asserts against "
+    "(each injection also lands in the plan ledger and, when a flight "
+    "recorder is attached, a chaos_<fault> incident bundle)",
+    ["fault"],
+    registry=REGISTRY,
+)
+PREEMPT_SKIPPED_TOTAL = Counter(
+    "preempt_skipped_total",
+    "try_preempt opportunities that could not be served, by reason "
+    "(oversubscribe_off | not_notebook_owner | legacy_scan | "
+    "no_viable_victims) — makes the TPUJob-vs-TPUJob preemption gap "
+    "(ROADMAP item 5) a visible counter instead of a silent skip",
+    ["reason"],
+    registry=REGISTRY,
+)
+NOTEBOOK_FAILOVER_TOTAL = Counter(
+    "notebook_failover_total",
+    "Active-replica deaths that promoted a warm standby via "
+    "demand-resume (NotebookOS replicated-kernel failover)",
+    registry=REGISTRY,
+)
+NOTEBOOK_FAILOVER_SECONDS = Histogram(
+    "notebook_failover_seconds",
+    "Active-replica death detection to the promoted standby fully "
+    "ready (state restored, chips re-bound through gang_bind) — the "
+    "latency that must beat cold provisioning by >=10x",
+    buckets=(0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0),
+    registry=REGISTRY,
+)
+NOTEBOOK_MIGRATION_TOTAL = Counter(
+    "notebook_migration_total",
+    "Live migrations (checkpoint -> drain -> re-bind on different "
+    "nodes) by trigger (api | fragmentation)",
+    ["trigger"],
+    registry=REGISTRY,
+)
+
 # ---- error accounting: no silent except Exception (KFRM005) ----------
 SWALLOWED_ERRORS_TOTAL = Counter(
     "swallowed_errors",

@@ -37,21 +37,14 @@ class Request:
 
 
 def _chaos_reconcile_sleep(controller: str) -> None:
-    """Fault injection for the perf-ratchet red-run demo:
-    ``KFRM_CHAOS_RECONCILE_SLEEP_MS=<ms>`` stalls every reconcile (or
-    only ``KFRM_CHAOS_RECONCILE_CONTROLLER=<name>``'s) by that long,
-    inside the reconcile span so the injected latency lands on the
-    trace's critical path exactly where a real slow hop would. Off
-    unless the env var is set; never enabled in production paths."""
-    import os
-    ms = os.environ.get("KFRM_CHAOS_RECONCILE_SLEEP_MS")
-    if not ms:
-        return
-    only = os.environ.get("KFRM_CHAOS_RECONCILE_CONTROLLER", "")
-    if only and only != controller:
-        return
-    import time
-    time.sleep(float(ms) / 1000.0)
+    """Reconcile-span fault injection, delegated to the chaos engine:
+    seeded ``FaultPlan`` stalls plus the legacy perf-ratchet env hook
+    (``KFRM_CHAOS_RECONCILE_SLEEP_MS`` / ``_CONTROLLER``) both land
+    inside the reconcile span so injected latency sits on the trace's
+    critical path exactly where a real slow hop would. No-op unless a
+    plan is installed or the env var is set."""
+    from kubeflow_rm_tpu.controlplane import chaos
+    chaos.maybe_stall(controller)
 
 
 class Controller:
@@ -279,7 +272,8 @@ class Manager:
 
     def run_forever(self, stop=None, poll_interval_s: float = 1.0,
                     on_error: Callable | None = None,
-                    workers: int = 1, elector=None) -> None:
+                    workers: int = 1, elector=None,
+                    resync_interval_s: float | None = None) -> None:
         """In-cluster serving loop: drain the queues whenever watch
         events (fanned into ``_on_event`` by the kube adapter's watch
         threads) or timed requeues produce work; sleep ``poll_interval_s``
@@ -300,11 +294,31 @@ class Manager:
         accumulating in the (deduped) queues while standing by, and on
         promotion the queues are resynced with ``enqueue_all`` — so a
         standby takes over within one lease duration with a warm cache
-        and a complete work list."""
+        and a complete work list.
+
+        ``resync_interval_s`` (opt-in) periodically re-enqueues every
+        primary — controller-runtime's SyncPeriod. Level-triggered
+        reconcilers converge from any state, so a periodic resync heals
+        whatever a lost watch event (network blip, chaos ``watch_drop``)
+        left stale, bounding staleness by the interval."""
         import logging
         import threading
+        import time as _time
         stop = stop or threading.Event()
         logger = logging.getLogger("kubeflow_rm_tpu.manager")
+
+        last_resync = _time.monotonic()
+
+        def maybe_resync():
+            nonlocal last_resync
+            if resync_interval_s is None:
+                return
+            if elector is not None and not elector.is_leader:
+                return
+            now = _time.monotonic()
+            if now - last_resync >= resync_interval_s:
+                last_resync = now
+                self.enqueue_all()
 
         if elector is not None:
             def _on_promoted():
@@ -333,6 +347,7 @@ class Manager:
                     report_errors()
                     self._wake.wait(poll_interval_s)
                     continue
+                maybe_resync()
                 try:
                     self._drain_serial(stop, elector)
                 except RuntimeError as e:
@@ -359,6 +374,7 @@ class Manager:
                 # work-queue rate limiter's job in controller-runtime
                 if stop.wait(0.01):
                     break
+                maybe_resync()
                 for c in self.controllers:
                     for req in self._queues[c.name].pop_ready():
                         pool.submit(self._reconcile_one, c, req)

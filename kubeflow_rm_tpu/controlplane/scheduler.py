@@ -304,27 +304,33 @@ class SchedulerCache:
 
     # -- assume / confirm / forget (the bind protocol) -----------------
     def gang_bind(self, pods: list[dict], *,
-                  allow_virtual: bool) -> dict[tuple, str] | None:
+                  allow_virtual: bool,
+                  exclude_nodes: set[str] | None = None
+                  ) -> dict[tuple, str] | None:
         """Place a whole gang all-or-nothing. Returns ``{(ns, name):
         node_name}`` with every placement *assumed* in the cache, or
         None (nothing charged) when the gang doesn't fit. The caller
         must ``confirm`` each bind after its apiserver write lands, or
-        ``forget`` it on failure."""
+        ``forget`` it on failure. ``exclude_nodes`` bars named nodes
+        from the plan — live migration's re-bind passes the nodes the
+        slice just drained off so it genuinely moves."""
         from kubeflow_rm_tpu.controlplane import metrics, tracing
         self._ensure_fresh()
         with tracing.start_span_if_active(
                 "gang_bind", attrs={"pods": len(pods),
                                     "allow_virtual": allow_virtual}) as sp:
             t0 = time.perf_counter()
-            plan = self._try_gang(pods, allow_virtual)
+            plan = self._try_gang(pods, allow_virtual,
+                                  exclude_nodes=exclude_nodes)
             result = "bound" if plan is not None else "unschedulable"
             metrics.SCHEDULE_LATENCY_SECONDS.labels(
                 result=result).observe(time.perf_counter() - t0)
             sp.set_attr("result", result)
         return plan
 
-    def _try_gang(self, pods: list[dict],
-                  allow_virtual: bool) -> dict[tuple, str] | None:
+    def _try_gang(self, pods: list[dict], allow_virtual: bool,
+                  exclude_nodes: set[str] | None = None
+                  ) -> dict[tuple, str] | None:
         # pick first (selection without locks), then verify-and-commit
         # under the chosen nodes' locks; capacity taken by a concurrent
         # gang between the two phases fails verification and retries
@@ -357,6 +363,8 @@ class SchedulerCache:
                 need_cpu = _pod_cpu(pod)
                 chosen = None
                 for node in nodes:
+                    if exclude_nodes and node.name in exclude_nodes:
+                        continue
                     if selector and not matches_selector(
                             node.labels, {"matchLabels": selector}):
                         continue

@@ -152,9 +152,21 @@ class ShardRunner:
         self.wait_ready(timeout, names=[name])
 
     def _watchdog(self) -> None:
-        from kubeflow_rm_tpu.controlplane import metrics
+        from kubeflow_rm_tpu.controlplane import chaos, metrics
         while not self._stopping:
             time.sleep(0.2)
+            # seeded shard-SIGKILL: one chaos opportunity per watchdog
+            # tick; the kill lands through the same ``kill`` verb the
+            # explicit chaos test uses, and this very loop observes the
+            # death and respawns in place
+            alive = [n for n, p in self._procs.items() if p.is_alive()]
+            victim = chaos.shard_kill_victim(alive)
+            if victim is not None and not self._stopping:
+                log.warning("chaos: SIGKILLing %s", victim)
+                try:
+                    self.kill(victim)
+                except (OSError, KeyError):
+                    metrics.swallowed("shard.runner", "chaos kill")
             for name, p in list(self._procs.items()):
                 if self._stopping or p.is_alive():
                     continue

@@ -33,12 +33,15 @@ pin-for-lifetime behavior: no idle suspension, no preemption.
 
 from __future__ import annotations
 
+import contextlib
 import datetime
 import json
+import logging
 import time
 from typing import Callable
 
-from kubeflow_rm_tpu.controlplane import metrics, scheduler
+from kubeflow_rm_tpu.analysis.lockgraph import make_lock
+from kubeflow_rm_tpu.controlplane import chaos, metrics, scheduler
 from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
 from kubeflow_rm_tpu.controlplane.api.meta import (
     annotations_of,
@@ -55,6 +58,8 @@ from kubeflow_rm_tpu.controlplane.runtime import (
 )
 
 DEFAULT_CHECK_PERIOD_MIN = 1.0
+
+log = logging.getLogger("kubeflow_rm_tpu.suspend")
 
 # annotation bumped on pending pods to requeue their owner StatefulSet
 # when a drain returns chips to the pool (see kick_pending_pods)
@@ -90,6 +95,8 @@ class InMemoryStateStore:
         self._saved: dict[tuple, dict] = {}
 
     def snapshot(self, notebook: dict) -> dict:
+        chaos.checkpoint_write_fault(
+            f"store:{namespace_of(notebook)}/{name_of(notebook)}")
         ann = annotations_of(notebook)
         try:
             step = int(ann.get(nb_api.TRAINING_STEP_ANNOTATION) or 0)
@@ -122,6 +129,8 @@ class CheckpointerStateStore:
         self._manager_for = manager_for
 
     def snapshot(self, notebook: dict) -> dict:
+        chaos.checkpoint_write_fault(
+            f"store:{namespace_of(notebook)}/{name_of(notebook)}")
         mgr = self._manager_for(namespace_of(notebook), name_of(notebook))
         wait = getattr(mgr, "wait", None)
         if wait is not None:
@@ -142,6 +151,27 @@ class CheckpointerStateStore:
 
 
 _state_store = InMemoryStateStore()
+
+# ---- per-notebook checkpoint serialization ---------------------------
+# A suspend (snapshot + stamp) racing a promote/resume (restore + stamp)
+# on the SAME notebook must never interleave: the loser could restore a
+# half-written token into a standby. One ranked lock per notebook key,
+# held across the store call AND its annotation CAS; distinct notebooks
+# never contend.
+_store_locks: dict[tuple, object] = {}
+_store_locks_guard = make_lock("suspend.store_registry")
+
+
+@contextlib.contextmanager
+def _store_guard(namespace: str, name: str):
+    key = (namespace, name)
+    with _store_locks_guard:
+        lock = _store_locks.get(key)
+        if lock is None:
+            lock = _store_locks[key] = make_lock(
+                "suspend.store", rank=f"{namespace}/{name}")
+    with lock:
+        yield
 
 
 def set_state_store(store) -> None:
@@ -196,6 +226,11 @@ def initiate_suspend(api: APIServer, notebook: dict, *,
         ann = annotations_of(nb)
         if nb_api.SUSPEND_ANNOTATION in ann:
             return False  # already suspending/suspended
+        if nb_api.RESUME_REQUESTED_ANNOTATION in ann:
+            # a resume (or a replica promotion — failover stamps the
+            # same annotation) owns the slice right now; parking on
+            # top would clobber its checkpoint token mid-restore
+            return False
         if not token_box:
             token_box.append(store.snapshot(nb))
         set_annotation(nb, nb_api.SUSPEND_ANNOTATION,
@@ -205,10 +240,13 @@ def initiate_suspend(api: APIServer, notebook: dict, *,
                        json.dumps(token_box[0]))
         # a fresh cycle: clear residue from any previous one
         ann.pop(nb_api.SUSPEND_DRAINED_ANNOTATION, None)
-        ann.pop(nb_api.RESUME_REQUESTED_ANNOTATION, None)
         return True
 
-    live = _update_retrying(api, notebook, mutate)
+    # snapshot + stamp is one critical section per notebook: a
+    # concurrent promote/resume must observe either the pre-suspend
+    # or the fully-stamped state, never a half-written token
+    with _store_guard(namespace_of(notebook), name_of(notebook)):
+        live = _update_retrying(api, notebook, mutate)
     if token_box:  # we actually initiated (not a no-op)
         api.record_event(
             live, "Normal", "Suspending",
@@ -244,6 +282,50 @@ def request_resume(api: APIServer, notebook: dict, *,
             live, "Normal", "Resuming",
             f"resume requested ({source}); re-ganging the slice and "
             "restoring checkpointed state")
+    return live
+
+
+def initiate_migration(api: APIServer, notebook: dict, *,
+                       trigger: str = "api", store=None) -> dict:
+    """Live migration = the suspend/resume primitive aimed at a
+    *different* placement: record the nodes the slice currently
+    occupies as the rebind's exclusion set, stamp the migrate request,
+    and drive the normal suspend lifecycle (reason="migrate"). The
+    drain auto-resumes (never parks) and ``gang_bind`` skips the
+    excluded nodes, so the slice comes back elsewhere with its state
+    restored. ``trigger`` is "api" (explicit drain verb) or
+    "fragmentation" (the compaction autopilot). Idempotent."""
+    name, ns = name_of(notebook), namespace_of(notebook)
+    nodes = sorted({
+        deep_get(p, "spec", "nodeName")
+        for p in api.list("Pod", ns)
+        if (p["metadata"].get("labels") or {}).get(
+            nb_api.NOTEBOOK_NAME_LABEL) == name
+        and deep_get(p, "spec", "nodeName")})
+    acted: list = []
+
+    def mutate(nb: dict) -> bool:
+        ann = annotations_of(nb)
+        if (nb_api.MIGRATE_REQUESTED_ANNOTATION in ann
+                or nb_api.SUSPEND_ANNOTATION in ann
+                or nb_api.STOP_ANNOTATION in ann
+                or nb_api.RESUME_REQUESTED_ANNOTATION in ann):
+            return False  # mid-lifecycle: nothing to migrate from
+        set_annotation(nb, nb_api.MIGRATE_REQUESTED_ANNOTATION,
+                       api.clock().isoformat())
+        set_annotation(nb, nb_api.MIGRATE_EXCLUDE_ANNOTATION,
+                       json.dumps(nodes))
+        acted.append(True)
+        return True
+
+    live = _update_retrying(api, notebook, mutate)
+    if acted:
+        metrics.NOTEBOOK_MIGRATION_TOTAL.labels(trigger=trigger).inc()
+        api.record_event(
+            live, "Normal", "Migrating",
+            f"live migration requested ({trigger}): checkpoint, drain "
+            f"off {nodes}, re-bind elsewhere")
+        live = initiate_suspend(api, live, reason="migrate", store=store)
     return live
 
 
@@ -326,6 +408,10 @@ class SuspendController(Controller):
     def _reconcile_suspending(self, api: APIServer, notebook: dict):
         ann = annotations_of(notebook)
         if nb_api.SUSPEND_DRAINED_ANNOTATION in ann:
+            if nb_api.MIGRATE_REQUESTED_ANNOTATION in ann:
+                # a migration never parks: the drain completing IS the
+                # resume trigger — the re-bind excludes the old nodes
+                request_resume(api, notebook, source="migration")
             return None  # drained and parked; resume is event-driven
         name, ns = name_of(notebook), namespace_of(notebook)
         pods = [p for p in api.list("Pod", ns)
@@ -365,6 +451,8 @@ class SuspendController(Controller):
                 f"slice drained; {nb_api.total_hosts(live)} host(s) of "
                 "chips returned to the pool")
             kick_pending_pods(api, now=now.isoformat())
+        if nb_api.MIGRATE_REQUESTED_ANNOTATION in annotations_of(live):
+            request_resume(api, live, source="migration")
         return None
 
     # -- resume half -----------------------------------------------------
@@ -376,6 +464,7 @@ class SuspendController(Controller):
             # periodic tick below is only a backstop for lost events
             return self.check_period.total_seconds()
         ann = annotations_of(notebook)
+        was_migration = nb_api.MIGRATE_REQUESTED_ANNOTATION in ann
         token = None
         raw = ann.get(nb_api.SUSPEND_CHECKPOINT_ANNOTATION)
         if raw:
@@ -383,9 +472,6 @@ class SuspendController(Controller):
                 token = json.loads(raw)
             except ValueError:
                 token = None
-        t0 = time.perf_counter()
-        restored = self.store.restore(notebook, token)
-        restore_s = time.perf_counter() - t0
         now = api.clock()
         requested = _parse_ts(ann.get(nb_api.RESUME_REQUESTED_ANNOTATION))
 
@@ -397,12 +483,21 @@ class SuspendController(Controller):
             a.pop(nb_api.SUSPEND_CHECKPOINT_ANNOTATION, None)
             a.pop(nb_api.SUSPEND_DRAINED_ANNOTATION, None)
             a.pop(nb_api.SUSPEND_REASON_ANNOTATION, None)
+            a.pop(nb_api.MIGRATE_REQUESTED_ANNOTATION, None)
+            a.pop(nb_api.MIGRATE_EXCLUDE_ANNOTATION, None)
             if restored is not None and "step" in restored:
                 set_annotation(nb, nb_api.RESTORED_STEP_ANNOTATION,
                                str(restored["step"]))
             return True
 
-        live = _update_retrying(api, notebook, mutate)
+        # restore + finalize under the same per-notebook guard the
+        # suspend half holds: two racers (suspend vs promote) serialize
+        # here instead of interleaving a half-restored standby
+        with _store_guard(namespace_of(notebook), name_of(notebook)):
+            t0 = time.perf_counter()
+            restored = self.store.restore(notebook, token)
+            restore_s = time.perf_counter() - t0
+            live = _update_retrying(api, notebook, mutate)
         if nb_api.RESUME_REQUESTED_ANNOTATION not in annotations_of(live):
             metrics.SUSPEND_RESUME_SECONDS.labels(
                 phase="restore").observe(restore_s)
@@ -416,6 +511,17 @@ class SuspendController(Controller):
                 "slice re-ganged and state restored"
                 + (f" at step {restored['step']}"
                    if restored and "step" in restored else ""))
+            if was_migration:
+                nodes = sorted({
+                    deep_get(p, "spec", "nodeName")
+                    for p in api.list("Pod", namespace_of(live))
+                    if (p["metadata"].get("labels") or {}).get(
+                        nb_api.NOTEBOOK_NAME_LABEL) == name_of(live)
+                    and deep_get(p, "spec", "nodeName")})
+                api.record_event(
+                    live, "Normal", "Migrated",
+                    f"slice live-migrated: re-ganged on {nodes} with "
+                    "state restored")
         return None
 
     # -- idle initiation -------------------------------------------------
@@ -454,6 +560,371 @@ class SuspendController(Controller):
         return self.check_period.total_seconds()
 
 
+# ---- replicated kernels: warm standbys + demand-resume failover ------
+
+def _parse_states(ann: dict) -> dict | None:
+    raw = ann.get(nb_api.REPLICA_STATES_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        st = json.loads(raw)
+    except ValueError:
+        return None
+    return st if isinstance(st, dict) else None
+
+
+class ReplicaFailoverController(Controller):
+    """NotebookOS replicated kernels over the suspend/resume primitive.
+
+    ``spec.replicas: R`` > 1 keeps one *active* replica holding the
+    chips and R−1 parked CPU-only standbys (rendered by the notebook
+    controller as a ``{name}-standby`` StatefulSet) whose warm state is
+    the checkpoint token this controller refreshes as the active
+    replica's durable training step advances.
+
+    On active-replica death — a Failed gang pod (kubelet detection) or
+    a rump slice — a standby promotes by *demand-resume*: one CAS
+    stamps the warm checkpoint token + resume request + failover clock
+    and rotates the active-replica pointer; the dead gang's pods are
+    deleted and their cache charges released, and the existing resume
+    machinery re-binds chips through ``gang_bind`` and restores state.
+    Promotion completes (promoting → active, failover latency observed)
+    when the resume finishes — warm-standby takeover at resume latency
+    instead of cold-provision latency."""
+
+    kind = nb_api.KIND
+
+    def __init__(self, store=None):
+        self._store = store
+
+    @property
+    def store(self):
+        return self._store if self._store is not None else _state_store
+
+    def watches(self):
+        return (("Pod", map_by_label(nb_api.NOTEBOOK_NAME_LABEL)),)
+
+    def reconcile(self, api: APIServer, req: Request):
+        try:
+            nb = api.get(nb_api.KIND, req.name, req.namespace)
+        except NotFound:
+            return None
+        if nb["metadata"].get("deletionTimestamp"):
+            return None
+        replicas = nb_api.replicas_of(nb)
+        ann = annotations_of(nb)
+        states = _parse_states(ann)
+        if replicas <= 1:
+            if states is not None:
+                self._clear_replica_state(api, nb)
+            return None
+        if states is None:
+            return self._init_states(api, nb, replicas)
+        if nb_api.STOP_ANNOTATION in ann:
+            return None  # user-stopped: drained pods are expected
+        if (nb_api.SUSPEND_ANNOTATION in ann
+                or nb_api.RESUME_REQUESTED_ANNOTATION in ann):
+            return None  # mid suspend/resume; pod events requeue us
+        hosts = nb_api.total_hosts(nb)
+        name, ns = req.name, req.namespace
+        pods = [p for p in api.list("Pod", ns)
+                if (p["metadata"].get("labels") or {}).get(
+                    nb_api.NOTEBOOK_NAME_LABEL) == name
+                and not p["metadata"].get("deletionTimestamp")]
+        failed = [p for p in pods
+                  if deep_get(p, "status", "phase") == "Failed"]
+        running = [p for p in pods
+                   if deep_get(p, "status", "phase") == "Running"]
+        promoting = [i for i, s in states.items() if s == "promoting"]
+        ready = deep_get(nb, "status", "readyReplicas", default=0)
+        if promoting:
+            # promotion in flight: the informer can still hold the dead
+            # gang's Failed pods (we just deleted them) and the re-bound
+            # slice recreates pods one at a time — both read as "death"
+            # and would ping-pong the active pointer. Deaths of the
+            # promoted gang itself heal through slice restart, so the
+            # only move here is finishing the promotion.
+            if ready >= hosts:
+                return self._finalize_promotion(api, nb, ann)
+            return None
+        if ready >= hosts:
+            # a Failed pod (or rump) while status still reads fully
+            # Ready is single-source evidence: either a genuine death
+            # whose status mirror hasn't landed (the mirror write
+            # requeues us in ms) or a stale informer view of a gang we
+            # already replaced (which must NOT rotate the pointer
+            # again). Require both sources to agree before acting.
+            self._refresh_warm(api, nb, ann)
+            return None
+        if failed or (running and len(pods) < hosts):
+            evidence = "; ".join(
+                [f"{name_of(p)}({p['metadata'].get('uid', '?')})="
+                 f"{deep_get(p, 'status', 'phase')}" for p in failed]
+                or [f"rump slice {len(pods)}/{hosts}"])
+            return self._failover(api, nb, ann, hosts, evidence)
+        return None
+
+    def _clear_replica_state(self, api: APIServer, nb: dict):
+        def mutate(o: dict) -> bool:
+            a = annotations_of(o)
+            if nb_api.REPLICA_STATES_ANNOTATION not in a:
+                return False
+            for k in (nb_api.REPLICA_STATES_ANNOTATION,
+                      nb_api.ACTIVE_REPLICA_ANNOTATION,
+                      nb_api.WARM_CHECKPOINT_ANNOTATION,
+                      nb_api.FAILOVER_T0_ANNOTATION):
+                a.pop(k, None)
+            return True
+        _update_retrying(api, nb, mutate)
+        return None
+
+    def _init_states(self, api: APIServer, nb: dict, replicas: int):
+        def mutate(o: dict) -> bool:
+            a = annotations_of(o)
+            if nb_api.REPLICA_STATES_ANNOTATION in a:
+                return False
+            st = {"0": "active"}
+            st.update({str(i): "standby" for i in range(1, replicas)})
+            set_annotation(o, nb_api.REPLICA_STATES_ANNOTATION,
+                           json.dumps(st))
+            set_annotation(o, nb_api.ACTIVE_REPLICA_ANNOTATION, "0")
+            return True
+        live = _update_retrying(api, nb, mutate)
+        if _parse_states(annotations_of(live)):
+            api.record_event(
+                live, "Normal", "ReplicasInitialized",
+                f"replica 0 active, {replicas - 1} warm standby(s)")
+        return None
+
+    def _refresh_warm(self, api: APIServer, nb: dict, ann: dict):
+        """Keep the standbys' warm token at the active replica's
+        durable step — what a promotion will restore."""
+        try:
+            cur = int(ann.get(nb_api.TRAINING_STEP_ANNOTATION) or 0)
+        except (TypeError, ValueError):
+            cur = 0
+        raw = ann.get(nb_api.WARM_CHECKPOINT_ANNOTATION)
+        if raw:
+            try:
+                if json.loads(raw).get("step", -1) >= cur:
+                    return  # warm state already current
+            except ValueError:
+                pass
+        token = self.store.snapshot(nb)
+        blob = json.dumps(token)
+
+        def mutate(o: dict) -> bool:
+            a = annotations_of(o)
+            if (nb_api.SUSPEND_ANNOTATION in a
+                    or nb_api.RESUME_REQUESTED_ANNOTATION in a
+                    or a.get(nb_api.WARM_CHECKPOINT_ANNOTATION) == blob):
+                return False
+            set_annotation(o, nb_api.WARM_CHECKPOINT_ANNOTATION, blob)
+            return True
+        _update_retrying(api, nb, mutate)
+
+    def _failover(self, api: APIServer, nb: dict, ann: dict,
+                  hosts: int, evidence: str = ""):
+        """Active replica died: promote the lowest standby by
+        demand-resume. One CAS stamps checkpoint token + resume request
+        + failover clock and rotates the pointer; then the dead gang is
+        torn down so the resume machinery re-binds cleanly."""
+        name, ns = name_of(nb), namespace_of(nb)
+        t0 = api.clock().isoformat()
+        warm = None
+        raw = ann.get(nb_api.WARM_CHECKPOINT_ANNOTATION)
+        if raw:
+            try:
+                warm = json.loads(raw)
+            except ValueError:
+                warm = None
+        acted: list = []
+
+        def mutate(o: dict) -> bool:
+            a = annotations_of(o)
+            if (nb_api.SUSPEND_ANNOTATION in a
+                    or nb_api.RESUME_REQUESTED_ANNOTATION in a
+                    or nb_api.STOP_ANNOTATION in a
+                    # failover clock still stamped: the previous
+                    # promotion hasn't finalized — refuse inside the
+                    # CAS so a stale reread can't double-rotate
+                    or nb_api.FAILOVER_T0_ANNOTATION in a):
+                return False  # a lifecycle already owns the slice
+            st = _parse_states(a)
+            if not st:
+                return False
+            standbys = sorted(int(i) for i, s in st.items()
+                              if s == "standby")
+            if not standbys:
+                return False  # nothing to promote
+            target = standbys[0]
+            old = a.get(nb_api.ACTIVE_REPLICA_ANNOTATION, "0")
+            token = warm if warm is not None else self.store.snapshot(o)
+            set_annotation(o, nb_api.SUSPEND_REASON_ANNOTATION,
+                           "failover")
+            set_annotation(o, nb_api.SUSPEND_CHECKPOINT_ANNOTATION,
+                           json.dumps(token))
+            set_annotation(o, nb_api.RESUME_REQUESTED_ANNOTATION, t0)
+            set_annotation(o, nb_api.FAILOVER_T0_ANNOTATION, t0)
+            set_annotation(o, nb_api.ACTIVE_REPLICA_ANNOTATION,
+                           str(target))
+            if str(old) in st:
+                st[str(old)] = "standby"
+            st[str(target)] = "promoting"
+            set_annotation(o, nb_api.REPLICA_STATES_ANNOTATION,
+                           json.dumps(st))
+            acted[:] = [old, target]
+            return True
+
+        # the promotion CAS is a restore-path writer: serialize with
+        # any concurrent suspend of the same notebook
+        with _store_guard(ns, name):
+            live = _update_retrying(api, nb, mutate)
+        if not acted:
+            return None
+        api.record_event(
+            live, "Warning", "FailingOver",
+            f"active replica {acted[0]} died"
+            + (f" ({evidence})" if evidence else "")
+            + f"; standby {acted[1]} promoting by demand-resume "
+            "(warm checkpoint, re-binding chips)")
+        # tear the dead gang down by ordinal and release cache charges
+        # so the re-bind sees the chips immediately
+        sched = (scheduler.cache_for(api)
+                 if not scheduler.legacy_scan() else None)
+        for i in range(hosts):
+            try:
+                api.delete("Pod", f"{name}-{i}", ns)
+            except NotFound:
+                pass
+            if sched is not None:
+                sched.release((ns, f"{name}-{i}"))
+        return None
+
+    def _finalize_promotion(self, api: APIServer, nb: dict, ann: dict):
+        now = api.clock()
+        t0 = _parse_ts(ann.get(nb_api.FAILOVER_T0_ANNOTATION))
+        acted: list = []
+
+        def mutate(o: dict) -> bool:
+            a = annotations_of(o)
+            if nb_api.RESUME_REQUESTED_ANNOTATION in a:
+                return False  # resume still in flight
+            st = _parse_states(a)
+            if not st:
+                return False
+            promoting = [i for i, s in st.items() if s == "promoting"]
+            if not promoting:
+                return False
+            for i in promoting:
+                st[i] = "active"
+            set_annotation(o, nb_api.REPLICA_STATES_ANNOTATION,
+                           json.dumps(st))
+            a.pop(nb_api.FAILOVER_T0_ANNOTATION, None)
+            acted[:] = promoting
+            return True
+
+        live = _update_retrying(api, nb, mutate)
+        if acted:
+            metrics.NOTEBOOK_FAILOVER_TOTAL.inc()
+            if t0 is not None:
+                metrics.NOTEBOOK_FAILOVER_SECONDS.observe(
+                    max(0.0, (now - t0).total_seconds()))
+            step = annotations_of(live).get(
+                nb_api.RESTORED_STEP_ANNOTATION)
+            api.record_event(
+                live, "Normal", "FailedOver",
+                f"replica {acted[0]} promoted to active; state restored"
+                + (f" at step {step}" if step is not None else ""))
+        return None
+
+
+# ---- fragmentation-triggered live migration (compaction) -------------
+
+_auto_migration = False
+
+
+def set_auto_migration(enabled: bool) -> None:
+    """Enable the compaction autopilot: a gang admissible only after
+    defragmentation triggers a live migration of a small victim slice.
+    Off by default — the static-placement arm and pre-existing suites
+    keep today's behavior."""
+    global _auto_migration
+    _auto_migration = bool(enabled)
+
+
+def auto_migration() -> bool:
+    return _auto_migration
+
+
+def try_compact_migration(api: APIServer, sts: dict,
+                          unbound: list[dict],
+                          sched: "scheduler.SchedulerCache", *,
+                          allow_virtual: bool) -> None:
+    """A gang failed to bind AND the fragmentation gauge says the free
+    chips would seat it if they weren't stranded: live-migrate the
+    smallest victim whose removal admits the waiter. The victim drains
+    off its nodes (checkpoint → drain, excluded from rebinding there)
+    and the freed contiguous capacity admits the waiter; the victim
+    re-gangs wherever fits (best-effort — it parks until capacity
+    otherwise). At most one migration in flight cluster-wide keeps the
+    autopilot deterministic and non-thrashing."""
+    if (not _auto_migration or not oversubscribe()
+            or scheduler.legacy_scan()):
+        return
+    needed = sum(scheduler._pod_chips(p) for p in unbound)
+    if not needed:
+        return
+    stats = sched.stats()
+    if stats["free_chips"] < needed or stats["fragmentation"] <= 0.0:
+        return  # not a fragmentation problem: capacity is simply short
+    scan = getattr(api, "scan", api.list)
+    waiter_key = (namespace_of(sts),
+                  (sts["metadata"].get("labels") or {}).get(
+                      nb_api.NOTEBOOK_NAME_LABEL) or name_of(sts))
+    candidates: list[_Victim] = []
+    for nb in scan(nb_api.KIND):
+        ann = annotations_of(nb)
+        if nb_api.MIGRATE_REQUESTED_ANNOTATION in ann:
+            return  # a migration is already in flight: let it land
+        if (nb["metadata"].get("deletionTimestamp")
+                or nb_api.SUSPEND_ANNOTATION in ann
+                or nb_api.STOP_ANNOTATION in ann
+                or nb_api.RESUME_REQUESTED_ANNOTATION in ann
+                or nb_api.is_pinned(nb)):
+            continue
+        if (namespace_of(nb), name_of(nb)) == waiter_key:
+            continue
+        name, ns = name_of(nb), namespace_of(nb)
+        pods = [p for p in scan("Pod", ns)
+                if (p["metadata"].get("labels") or {}).get(
+                    nb_api.NOTEBOOK_NAME_LABEL) == name
+                and deep_get(p, "spec", "nodeName")
+                and deep_get(p, "status", "phase")
+                not in scheduler.TERMINAL_PHASES]
+        v = _Victim(nb, pods, nb_api.priority_of(nb), "")
+        if v.chips:
+            candidates.append(v)
+    # smallest slice first: compaction should shuffle the cheapest
+    # tenant, not shatter a big one
+    candidates.sort(key=lambda v: (v.chips, name_of(v.notebook)))
+    by_node = sched.free_by_node()
+    free = {node: f for node, (f, _labels) in by_node.items()}
+    labels = {node: lb for node, (_f, lb) in by_node.items()}
+    for v in candidates:
+        if _fits(unbound, free, dict(v.per_node), labels, allow_virtual):
+            api.record_event(
+                sts, "Normal", "CompactionMigration",
+                f"gang admissible only after compaction (fragmentation "
+                f"{stats['fragmentation']:.2f}, {stats['free_chips']:.0f}"
+                f" chips free); live-migrating "
+                f"{name_of(v.notebook)} ({v.chips:.0f} chips) off "
+                f"{sorted(v.per_node)}")
+            initiate_migration(api, v.notebook, trigger="fragmentation")
+            return
+    return
+
+
 # ---- preemptive gang-bind --------------------------------------------
 
 class _Victim:
@@ -487,22 +958,32 @@ def try_preempt(api: APIServer, sts: dict, unbound: list[dict],
     order is (priority asc, idleness desc, fragmentation fit). Returns
     a bind plan like ``gang_bind`` or None."""
     if not oversubscribe() or scheduler.legacy_scan():
+        _preempt_skipped(
+            "oversubscribe_off" if not oversubscribe() else "legacy_scan",
+            sts)
         return None
     nb_name = (sts["metadata"].get("labels") or {}).get(
         nb_api.NOTEBOOK_NAME_LABEL)
     if not nb_name:
-        return None  # not a notebook slice: no priority to preempt with
+        # TPUJob-vs-TPUJob preemption (ROADMAP item 5) lands here: the
+        # gang's owner carries no Notebook priority to preempt with —
+        # a visible gap now, not a silent one
+        _preempt_skipped("not_notebook_owner", sts)
+        return None
     ns = namespace_of(sts)
     incoming = api.try_get(nb_api.KIND, nb_name, ns)
     if incoming is None:
+        _preempt_skipped("owner_missing", sts)
         return None
     incoming_pri = nb_api.priority_of(incoming)
     needed = sum(scheduler._pod_chips(p) for p in unbound)
     if not needed:
+        _preempt_skipped("no_chips_needed", sts)
         return None
 
     victims = _candidate_victims(api, incoming, incoming_pri, needed)
     if not victims:
+        _preempt_skipped("no_viable_victims", sts)
         return None
 
     by_node = sched.free_by_node()
@@ -518,7 +999,9 @@ def try_preempt(api: APIServer, sts: dict, unbound: list[dict],
         if _fits(unbound, free, extra, labels, allow_virtual):
             break
     else:
-        return None  # even suspending every candidate wouldn't fit
+        # even suspending every candidate wouldn't fit
+        _preempt_skipped("insufficient_victims", sts)
+        return None
 
     for v in chosen:
         initiate_suspend(api, v.notebook, reason="preempted")
@@ -554,6 +1037,16 @@ def try_preempt(api: APIServer, sts: dict, unbound: list[dict],
         f"({', '.join(name_of(v.notebook) for v in chosen)}) to admit "
         f"this {len(unbound)}-host gang")
     return sched.gang_bind(unbound, allow_virtual=allow_virtual)
+
+
+def _preempt_skipped(reason: str, sts: dict) -> None:
+    """Account for a preemption opportunity that could not be served —
+    the counter (``preempt_skipped_total{reason}``) plus a log line
+    turn the silent skips (notably non-Notebook gang owners) into a
+    measurable gap."""
+    metrics.PREEMPT_SKIPPED_TOTAL.labels(reason=reason).inc()
+    log.info("preemption skipped for %s/%s: %s",
+             namespace_of(sts), name_of(sts), reason)
 
 
 def _candidate_victims(api: APIServer, incoming: dict,

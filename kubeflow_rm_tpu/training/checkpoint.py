@@ -78,6 +78,13 @@ class Checkpointer:
         step = int(jax.device_get(raw))
         if step in self._mngr.all_steps():
             return False
+        # chaos-engine checkpoint-write fault, sys.modules-guarded so
+        # the training layer never pulls in the control plane itself —
+        # the hook only exists once a control-plane process imported it
+        import sys
+        _chaos = sys.modules.get("kubeflow_rm_tpu.controlplane.chaos")
+        if _chaos is not None:
+            _chaos.checkpoint_write_fault(f"checkpointer:{step}")
         return self._mngr.save(step, args=_ocp().args.StandardSave(state),
                                force=force)
 

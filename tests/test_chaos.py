@@ -1,0 +1,258 @@
+"""Seeded fault-injection engine (controlplane/chaos.py): determinism,
+per-fault attribution (counters + ledger), and each choke point —
+reconcile stalls, watch drop/dup, checkpoint-write failure, kubelet
+pod-kill — healing through the platform's own recovery ladders."""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import (
+    chaos, make_control_plane, metrics, suspend,
+)
+from kubeflow_rm_tpu.controlplane.api import notebook as nb_api
+from kubeflow_rm_tpu.controlplane.api.meta import annotations_of
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+    make_tpu_node,
+)
+from tests.cp_fixtures import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    chaos.uninstall()
+    suspend.set_state_store(suspend.InMemoryStateStore())
+    yield
+    chaos.uninstall()
+
+
+def _counter(fault):
+    return metrics.registry_value("chaos_faults_injected_total",
+                                  {"fault": fault})
+
+
+# ---- plan mechanics --------------------------------------------------
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError):
+        chaos.FaultSpec("meteor_strike", rate=1.0)
+
+
+def test_seeded_plan_is_deterministic():
+    def run(seed):
+        plan = chaos.FaultPlan(seed, [
+            chaos.FaultSpec("api_error", rate=0.3),
+            chaos.FaultSpec("api_timeout", rate=0.2),
+        ])
+        chaos.install(plan)
+        hits = []
+        for i in range(200):
+            try:
+                hits.append("E" if chaos.api_request_fault(
+                    "GET", f"/pods/{i}") else ".")
+            except TimeoutError:
+                hits.append("T")
+        chaos.uninstall()
+        return "".join(hits)
+
+    a, b, c = run(7), run(7), run(8)
+    assert a == b                    # same seed, same injection pattern
+    assert a != c                    # different seed diverges
+    assert {"E", "T"} <= set(a)      # both arms actually fired
+
+
+def test_limit_and_match_filters():
+    plan = chaos.install(chaos.FaultPlan(1, [
+        chaos.FaultSpec("api_error", rate=1.0, match="/notebooks",
+                        limit=2),
+    ]))
+    assert chaos.api_request_fault("GET", "/api/pods") is None  # no match
+    assert chaos.api_request_fault("POST", "/notebooks/a") is not None
+    assert chaos.api_request_fault("POST", "/notebooks/b") is not None
+    assert chaos.api_request_fault("POST", "/notebooks/c") is None  # cap
+    assert plan.counts["api_error"] == 2
+    assert len(plan.ledger()) == 2
+    assert plan.ledger()[0]["site"] == "POST /notebooks/a"
+
+
+def test_synthetic_503_shape():
+    resp = chaos.Synthetic503("GET /x")
+    assert resp.status_code == 503 and not resp.ok
+    assert resp.json()["code"] == 503
+    assert "chaos" in resp.json()["message"]
+
+
+def test_injection_counter_and_summary():
+    before = _counter("checkpoint_fail")
+    chaos.install(chaos.FaultPlan(3, [
+        chaos.FaultSpec("checkpoint_fail", rate=1.0, limit=1)]))
+    with pytest.raises(OSError, match="chaos"):
+        chaos.checkpoint_write_fault("store:u/nb")
+    chaos.checkpoint_write_fault("store:u/nb")  # over limit: no-op
+    plan = chaos.uninstall()
+    assert _counter("checkpoint_fail") == before + 1
+    assert plan.summary()["faults"] == {"checkpoint_fail": 1}
+    assert plan.summary()["opportunities"]["checkpoint_fail"] == 2
+
+
+def test_plan_from_args_parses_cli_spec():
+    plan = chaos.plan_from_args(9, "reconcile_stall:0.5:25, api_error")
+    kinds = {(s.fault, s.rate) for s in plan.specs}
+    assert ("reconcile_stall", 0.5) in kinds
+    assert ("api_error", 0.05) in kinds  # default rate
+    assert plan.specs[0].stall_ms == 25.0
+
+
+# ---- legacy env hook subsumed ----------------------------------------
+
+def test_legacy_env_stall_still_honored(monkeypatch):
+    import time as _time
+    slept = []
+    monkeypatch.setattr(_time, "sleep", lambda s: slept.append(s))
+    monkeypatch.setenv("KFRM_CHAOS_RECONCILE_SLEEP_MS", "40")
+    monkeypatch.setenv("KFRM_CHAOS_RECONCILE_CONTROLLER",
+                       "NotebookController")
+    chaos.maybe_stall("NotebookController")
+    chaos.maybe_stall("CullingController")  # filtered out
+    assert slept == [0.04]
+
+
+def test_plan_stall_fires_without_env(monkeypatch):
+    import time as _time
+    slept = []
+    monkeypatch.setattr(_time, "sleep", lambda s: slept.append(s))
+    monkeypatch.delenv("KFRM_CHAOS_RECONCILE_SLEEP_MS", raising=False)
+    chaos.install(chaos.FaultPlan(2, [
+        chaos.FaultSpec("reconcile_stall", rate=1.0, stall_ms=15.0,
+                        limit=1)]))
+    chaos.maybe_stall("NotebookController")
+    chaos.maybe_stall("NotebookController")
+    assert slept == [0.015]
+    assert _counter("reconcile_stall") >= 1
+
+
+# ---- watch faults against the real fanout ----------------------------
+
+def test_watch_drop_becomes_too_old_sentinel():
+    api = APIServer()
+    seen = []
+    api.add_watcher(lambda e, o, old: seen.append(e), name="probe")
+    chaos.install(chaos.FaultPlan(4, [
+        chaos.FaultSpec("watch_drop", rate=1.0, match="probe",
+                        limit=1)]))
+    api.create({"kind": "Namespace", "apiVersion": "v1",
+                "metadata": {"name": "w"}})
+    api.drain_watchers()
+    chaos.uninstall()
+    # the event was not silently lost: the watcher saw a detectable gap
+    assert seen == ["TOO_OLD"]
+    api.create({"kind": "Namespace", "apiVersion": "v1",
+                "metadata": {"name": "w2"}})
+    api.drain_watchers()
+    assert seen[-1] == "ADDED"  # plan gone, channel healthy again
+
+
+def test_watch_dup_delivers_twice():
+    api = APIServer()
+    seen = []
+    api.add_watcher(lambda e, o, old: seen.append(
+        (e, o["metadata"]["name"])), name="probe")
+    chaos.install(chaos.FaultPlan(4, [
+        chaos.FaultSpec("watch_dup", rate=1.0, match="probe", limit=1)]))
+    api.create({"kind": "Namespace", "apiVersion": "v1",
+                "metadata": {"name": "d"}})
+    api.drain_watchers()
+    chaos.uninstall()
+    assert seen == [("ADDED", "d"), ("ADDED", "d")]
+
+
+def test_controllers_converge_through_watch_drops():
+    """Dropped watch events on the manager's own watcher must not lose
+    a notebook: the drop is a TOO_OLD gap, and the manager's relist
+    (enqueue_all) heals whatever the gap hid."""
+    clock = FakeClock()
+    api, mgr = make_control_plane(clock=clock)
+    api.ensure_namespace("u")
+    api.create(make_tpu_node("n0", "v5p-8"))
+    chaos.install(chaos.FaultPlan(11, [
+        chaos.FaultSpec("watch_drop", rate=0.5, match="manager")]))
+    try:
+        api.create(make_notebook("dropped", "u",
+                                 accelerator_type="v5p-8"))
+        mgr.run_until_idle()
+    finally:
+        plan = chaos.uninstall()
+    mgr.run_until_idle()
+    nb = api.get(nb_api.KIND, "dropped", "u")
+    assert (nb.get("status") or {}).get("readyReplicas") == 1
+    assert plan.counts["watch_drop"] >= 1
+
+
+# ---- kubelet pod-kill heals through slice restart --------------------
+
+def test_pod_kill_recovers_via_slice_health():
+    clock = FakeClock()
+    api, mgr = make_control_plane(clock=clock)
+    api.ensure_namespace("u")
+    for i in range(2):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    api.create(make_notebook("victim", "u", accelerator_type="v5p-16"))
+    mgr.run_until_idle()
+    assert len(api.list("Pod", "u")) == 2
+
+    chaos.install(chaos.FaultPlan(5, [
+        chaos.FaultSpec("pod_kill", rate=1.0, match="u/victim",
+                        limit=1)]))
+    try:
+        mgr.enqueue_all()  # a quiet cluster needs a tick to roll dice
+        mgr.run_until_idle()
+    finally:
+        plan = chaos.uninstall()
+    mgr.run_until_idle()
+
+    assert plan.counts["pod_kill"] == 1
+    # SliceRestart tore the slice down whole and the STS rebuilt it
+    events = [e["reason"] for e in api.events_for(
+        api.get(nb_api.KIND, "victim", "u"))]
+    assert "SliceRestart" in events
+    pods = api.list("Pod", "u")
+    assert len(pods) == 2
+    assert all((p.get("status") or {}).get("phase") == "Running"
+               for p in pods)
+
+
+# ---- checkpoint faults surface, then the retry succeeds --------------
+
+def test_checkpoint_fault_delays_but_does_not_lose_suspend():
+    clock = FakeClock()
+    api, mgr = make_control_plane(
+        clock=clock, enable_suspend=True,
+        suspend_config={"suspend_idle_minutes": 30.0,
+                        "check_period_minutes": 1.0})
+    api.ensure_namespace("u")
+    for i in range(2):
+        api.create(make_tpu_node(f"n{i}", "v5p-16"))
+    nb = make_notebook("ckpt", "u", accelerator_type="v5p-16")
+    nb["metadata"]["annotations"] = {
+        nb_api.TRAINING_STEP_ANNOTATION: "42"}
+    api.create(nb)
+    mgr.run_until_idle()
+
+    chaos.install(chaos.FaultPlan(6, [
+        chaos.FaultSpec("checkpoint_fail", rate=1.0, limit=1)]))
+    try:
+        clock.advance(minutes=31)
+        mgr.run_until_idle()
+    finally:
+        plan = chaos.uninstall()
+    clock.advance(minutes=2)
+    mgr.run_until_idle()
+
+    assert plan.counts["checkpoint_fail"] == 1
+    ann = annotations_of(api.get(nb_api.KIND, "ckpt", "u"))
+    assert nb_api.SUSPEND_ANNOTATION in ann
+    assert json.loads(ann[nb_api.SUSPEND_CHECKPOINT_ANNOTATION]) == {
+        "step": 42}

@@ -56,22 +56,30 @@ def _run_meta(args, mode: str) -> dict:
 
     from kubeflow_rm_tpu.controlplane.obs.runmeta import build_run_meta
     interleave = os.environ.get("KFRM_RUN_INTERLEAVE")
+    arms = {
+        "mode": mode,
+        "shards": args.shards,
+        "wal": args.shards > 1 and not args.no_wal,
+        "cache": "off" if args.no_cache else "on",
+        "lock": "global" if args.global_lock else "sharded",
+        "writes": "serial" if args.serial_writes else "batched",
+        "schedule": "legacy" if args.legacy_schedule else "cache",
+        "oversubscribe": not args.no_oversubscribe,
+        "readiness": "poll" if args.poll_readiness else "push",
+        "tracing": not args.no_tracing,
+        "defrag": "active" if args.active_defrag else "off",
+        "notebooks": args.notebooks,
+        "concurrency": max(1, args.concurrency),
+    }
+    if mode == "diurnal":
+        # elastic arms: two diurnal artifacts are only comparable when
+        # the envelope and the chaos arm agree
+        arms.update(max_shards=args.max_shards,
+                    objects=args.diurnal_objects,
+                    chaos_split=bool(args.chaos_split),
+                    seed=args.seed)
     return build_run_meta(
-        "spawn_conformance",
-        {
-            "mode": mode,
-            "shards": args.shards,
-            "wal": args.shards > 1 and not args.no_wal,
-            "cache": "off" if args.no_cache else "on",
-            "lock": "global" if args.global_lock else "sharded",
-            "writes": "serial" if args.serial_writes else "batched",
-            "schedule": "legacy" if args.legacy_schedule else "cache",
-            "oversubscribe": not args.no_oversubscribe,
-            "readiness": "poll" if args.poll_readiness else "push",
-            "tracing": not args.no_tracing,
-            "notebooks": args.notebooks,
-            "concurrency": max(1, args.concurrency),
-        },
+        "spawn_conformance", arms,
         interleave_index=int(interleave) if interleave else None)
 
 
@@ -844,6 +852,285 @@ def _wallclock_once_sharded(args, phases) -> dict:
     return result
 
 
+def diurnal_main(args) -> int:
+    """A simulated production day over the ELASTIC shard fleet:
+    morning notebook rush -> midday TPUJob burst -> evening serving
+    flood -> night idle, with the SLO/queue-depth autoscaler driving
+    live split/merge (2 -> ``--max-shards`` -> 2) while the load is in
+    flight. The zero-loss audit at the end re-reads every object the
+    harness ever had acked — through the router AND from the shard the
+    final ring says owns it.
+
+    The autoscaler acts on real signals (federated ``workqueue_depth``
+    + burn-rate criticals); if a phase ends before the signals carry
+    the fleet to the envelope target, the harness forces the remaining
+    split/merge steps through the same handoff path and records them
+    as ``forced`` — CI asserts the envelope deterministically, the
+    signal-driven decisions stay visible in the artifact."""
+    import shutil
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kubeflow_rm_tpu.controlplane import chaos, metrics, suspend
+    from kubeflow_rm_tpu.controlplane.api import tpujob as tj_api
+    from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+        KubeAPIServer,
+        ShardedKubeAPIServer,
+    )
+    from kubeflow_rm_tpu.controlplane.obs import Observer
+    from kubeflow_rm_tpu.controlplane.shard import ShardRunner
+    from kubeflow_rm_tpu.controlplane.shard.elastic import (
+        ElasticShardManager,
+        ShardAutoscaler,
+    )
+
+    min_shards = max(2, args.shards)
+    if args.no_wal:
+        raise SystemExit("--diurnal requires WAL-backed shards "
+                         "(the handoff IS snapshot + WAL tail-replay)")
+    suspend.set_active_defrag(args.active_defrag)
+    plan = None
+    if args.chaos_split:
+        plan = chaos.install(chaos.FaultPlan(args.seed, [
+            chaos.FaultSpec("shard_split", rate=1.0, limit=1)]))
+
+    base_dir = tempfile.mkdtemp(prefix="conf-diurnal-")
+    runner = ShardRunner(min_shards, base_dir=base_dir, wal=True,
+                         manager_workers=args.manager_workers,
+                         hang_dump_s=args.hang_dump, tracing=False)
+    runner.start(timeout=120)
+    router = ShardedKubeAPIServer(runner.urls,
+                                  identity="diurnal-harness",
+                                  retry_window_s=20.0)
+    observer = Observer(interval_s=0.5, shard_urls=runner.urls,
+                        liveness=runner.liveness,
+                        run_meta=_run_meta(args, "diurnal"))
+    runner.set_on_death(observer.on_shard_death)
+    elastic = ElasticShardManager(runner, router, observer=observer)
+    scaler = ShardAutoscaler(elastic, observer,
+                             min_shards=min_shards,
+                             max_shards=args.max_shards,
+                             split_depth=args.split_depth,
+                             merge_depth=args.merge_depth,
+                             sustain=2, cooldown_s=2.0)
+
+    from kubeflow_rm_tpu.analysis.lockgraph import make_lock
+    created: list[tuple] = []
+    created_lock = make_lock("harness.diurnal_results")
+    errors: list[str] = []
+
+    def track(obj: dict) -> None:
+        try:
+            router.create(obj)
+        except Exception as e:  # noqa: BLE001 - audited, not raised
+            errors.append(f"{obj.get('kind')}/"
+                          f"{obj['metadata'].get('name')}: {e!r}")
+            return
+        meta = obj["metadata"]
+        with created_lock:
+            created.append((obj["kind"], meta["name"],
+                            meta.get("namespace")))
+
+    def pump(objs: list[dict], phase: str) -> float:
+        """Run one load wave through the pool while the main thread
+        ticks observer + autoscaler — splits/merges land DURING the
+        wave, so the fence/remap window sees live writers."""
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(
+                max_workers=max(4, args.concurrency)) as pool:
+            futs = [pool.submit(track, o) for o in objs]
+            while any(not f.done() for f in futs):
+                observer.tick()
+                scaler.tick()
+                time.sleep(0.15)
+            for f in futs:
+                f.result()
+        observer.tick()
+        scaler.tick()
+        print(f"  {phase}: {len(objs)} objects, "
+              f"{len(router.ring)} shards "
+              f"({time.monotonic() - t0:.1f}s)", file=sys.stderr)
+        return time.monotonic() - t0
+
+    total = max(60, args.diurnal_objects)
+    n_morning = int(total * 0.45)
+    n_midday = int(total * 0.25)
+    n_evening = total - n_morning - n_midday
+    namespaces = [f"day-{i}" for i in range(max(8, 3 * args.max_shards))]
+
+    phases_out: list[dict] = []
+    forced: list[dict] = []
+    t_start = time.monotonic()
+    try:
+        for ns in namespaces:
+            track({"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": ns}})
+
+        # -- morning: the notebook rush --
+        wave = [nb_api.make_notebook(f"rush-{i}",
+                                     namespaces[i % len(namespaces)])
+                for i in range(n_morning)]
+        dt = pump(wave, "morning rush")
+        phases_out.append({"phase": "morning", "objects": n_morning,
+                           "shards_after": len(router.ring),
+                           "duration_s": round(dt, 1)})
+
+        # -- midday: the TPUJob burst --
+        wave = [tj_api.make_tpujob(
+                    f"burst-{i}", namespaces[i % len(namespaces)],
+                    roles=[{"name": "learner", "replicas": 1,
+                            "cpu": "2"},
+                           {"name": "actors", "replicas": 2,
+                            "cpu": "1"}])
+                for i in range(n_midday)]
+        dt = pump(wave, "midday burst")
+        phases_out.append({"phase": "midday", "objects": n_midday,
+                           "shards_after": len(router.ring),
+                           "duration_s": round(dt, 1)})
+
+        # -- evening: the serving flood --
+        wave = [{"apiVersion": "apps/v1", "kind": "Deployment",
+                 "metadata": {
+                     "name": f"serve-{i}",
+                     "namespace": namespaces[i % len(namespaces)],
+                     "labels": {"app": "model-server"}},
+                 "spec": {"replicas": 2, "template": {"spec": {
+                     "containers": [{"name": "server",
+                                     "image": "model-server:latest"}],
+                 }}}}
+                for i in range(n_evening)]
+        dt = pump(wave, "evening flood")
+        # the envelope floor: whatever the signals did not claim by
+        # dusk is forced through the same handoff path
+        while len(router.ring) < args.max_shards:
+            name = elastic.split()
+            forced.append({"op": "split", "shard": name})
+        phases_out.append({"phase": "evening", "objects": n_evening,
+                           "shards_after": len(router.ring),
+                           "duration_s": round(dt, 1)})
+
+        # -- night: idle; sustained quiet merges the fleet back --
+        t0 = time.monotonic()
+        deadline = t0 + 60
+        while len(router.ring) > min_shards and \
+                time.monotonic() < deadline:
+            observer.tick()
+            scaler.tick()
+            time.sleep(0.2)
+        while len(router.ring) > min_shards:
+            name = elastic.merge()
+            forced.append({"op": "merge", "shard": name})
+        phases_out.append({"phase": "night", "objects": 0,
+                           "shards_after": len(router.ring),
+                           "duration_s": round(time.monotonic() - t0,
+                                               1)})
+
+        # -- the zero-loss audit --
+        observer.tick()
+        shard_clients = {n: KubeAPIServer(u, identity="auditor",
+                                          cache_reads=False)
+                         for n, u in runner.urls.items()}
+        lost: list[str] = []
+        misplaced: list[str] = []
+        for kind, name, ns in created:
+            if router.try_get(kind, name, ns) is None:
+                lost.append(f"{kind} {ns}/{name}")
+                continue
+            owner = router.shard_of(kind, name, ns)
+            if shard_clients[owner].try_get(kind, name, ns) is None:
+                misplaced.append(f"{kind} {ns}/{name} -> {owner}")
+
+        deaths = metrics.registry_value("shard_deaths_total")
+        splits = metrics.registry_value("shard_splits_total")
+        merges = metrics.registry_value("shard_merges_total")
+        max_seen = max([min_shards]
+                       + [len(e["members"]) for e in elastic.events]
+                       + [d["shards"] for d in scaler.decisions])
+        decision_counts: dict[str, int] = {}
+        for d in scaler.decisions:
+            decision_counts[d["decision"]] = \
+                decision_counts.get(d["decision"], 0) + 1
+
+        result = {
+            "run_meta": _run_meta(args, "diurnal"),
+            "mode": "diurnal",
+            "objects_created": len(created),
+            "create_errors": errors[:20],
+            "lost": len(lost),
+            "lost_sample": lost[:20],
+            "misplaced": len(misplaced),
+            "misplaced_sample": misplaced[:20],
+            "envelope": {
+                "min_shards": min_shards,
+                "max_shards": args.max_shards,
+                "max_reached": max_seen,
+                "final_shards": len(router.ring),
+            },
+            "splits_total": splits,
+            "merges_total": merges,
+            "forced_scale_steps": forced,
+            "autoscaler_decisions": decision_counts,
+            "decision_tail": [
+                {k: d[k] for k in
+                 ("decision", "shards", "mean_depth", "burning")}
+                for d in scaler.decisions[-12:]],
+            "scale_events": elastic.events,
+            "handoff": {
+                "objects_bulk": metrics.registry_value(
+                    "shard_handoff_objects_total",
+                    {"phase": "bulk"}),
+                "objects_tail": metrics.registry_value(
+                    "shard_handoff_objects_total",
+                    {"phase": "tail"}),
+            },
+            "shard_deaths_total": deaths,
+            "active_defrag": args.active_defrag,
+            "phases": phases_out,
+            "total_s": round(time.monotonic() - t_start, 1),
+        }
+        if plan is not None:
+            result["chaos"] = plan.summary()
+        try:
+            result["slo_shard_deaths"] = \
+                observer.engine.state_of("shard-deaths")
+        except KeyError:
+            result["slo_shard_deaths"] = "unconfigured"
+
+        # -- the day's invariants --
+        assert not errors, f"{len(errors)} creates errored: {errors[:5]}"
+        assert not lost, f"{len(lost)} objects LOST: {lost[:10]}"
+        assert not misplaced, \
+            f"{len(misplaced)} objects misplaced: {misplaced[:10]}"
+        assert splits >= 1 and merges >= 1, (splits, merges)
+        assert max_seen >= args.max_shards, \
+            f"never reached {args.max_shards} shards (peak {max_seen})"
+        assert len(router.ring) == min_shards
+        if plan is None:
+            # satellite: deliberate scale-downs are not deaths — the
+            # whole day's merges must leave the counter and the
+            # critical shard-death SLO untouched
+            assert deaths == 0, f"shard_deaths_total={deaths}"
+            assert result["slo_shard_deaths"] != "critical"
+        else:
+            assert plan.counts.get("shard_split", 0) >= 1, \
+                "chaos arm never fired"
+            assert deaths >= 1, "donor SIGKILL was not observed"
+    finally:
+        if plan is not None:
+            chaos.uninstall()
+        suspend.set_active_defrag(False)
+        runner.stop()
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    print("CONFORMANCE OK (diurnal)")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--slices", default="v5p-16=2",
@@ -914,6 +1201,41 @@ def main() -> int:
                          "the durable write-ahead log (the durability "
                          "A/B baseline arm; --shards 1 never engages "
                          "the WAL)")
+    ap.add_argument("--diurnal", action="store_true",
+                    help="simulated production day over the ELASTIC "
+                         "shard fleet: morning notebook rush, midday "
+                         "TPUJob burst, evening serving flood, night "
+                         "idle — the autoscaler live-splits/merges "
+                         "min->--max-shards->min under load, and the "
+                         "run fails on any lost or misplaced object "
+                         "(ELASTIC_r{N}.json artifact)")
+    ap.add_argument("--diurnal-objects", type=int, default=600,
+                    help="total objects the simulated day creates "
+                         "across its three waves (>=60)")
+    ap.add_argument("--max-shards", type=int, default=6,
+                    help="diurnal mode: the envelope ceiling the day "
+                         "scales up to (floor is max(2, --shards))")
+    ap.add_argument("--split-depth", type=float, default=6.0,
+                    help="diurnal mode: mean per-shard workqueue depth "
+                         "that counts as sustained pressure")
+    ap.add_argument("--merge-depth", type=float, default=1.0,
+                    help="diurnal mode: mean per-shard workqueue depth "
+                         "at or below which the fleet is idle")
+    ap.add_argument("--chaos-split", action="store_true",
+                    help="diurnal mode: seeded chaos arm that SIGKILLs "
+                         "the busiest donor mid-split (between bulk "
+                         "copy and tail replay); the watchdog respawn "
+                         "+ WAL tail-chase must still deliver zero "
+                         "loss")
+    ap.add_argument("--seed", type=int, default=1234,
+                    help="chaos seed for --chaos-split")
+    ap.add_argument("--active-defrag", action="store_true",
+                    help="promote compaction migration from last-"
+                         "resort to an active fragmentation-driven "
+                         "placement policy (scheduler idle passes "
+                         "migrate one victim whenever doing so grows "
+                         "the largest free contiguous block) — the "
+                         "defrag A/B arm")
     ap.add_argument("--hang-dump", type=float, default=0.0, metavar="S",
                     help="arm faulthandler to dump every thread's "
                          "stack after S seconds (CI contention-stress "
@@ -951,11 +1273,14 @@ def main() -> int:
     runtime.set_serial_writes(args.serial_writes)
     scheduler.set_legacy_scan(args.legacy_schedule)
     suspend.set_oversubscribe(not args.no_oversubscribe)
+    suspend.set_active_defrag(args.active_defrag)
     if args.hang_dump > 0:
         # a deadlock in the sharded locking scheme must fail CI with
         # stacks, not eat the job's timeout silently
         import faulthandler
         faulthandler.dump_traceback_later(args.hang_dump, exit=True)
+    if args.diurnal:
+        return diurnal_main(args) or _lockgraph_gate(args)
     if args.wallclock:
         return wallclock_main(args) or _lockgraph_gate(args)
 

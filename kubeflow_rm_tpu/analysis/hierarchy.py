@@ -69,6 +69,10 @@ LOCK_HIERARCHY: dict[str, int] = {
     "leases.elector": 240,
     "informer.prime": 250,
     "cache.store": 260,             # ObjectStore RLock + its condvar
+    # held across an ENTIRE split/merge handoff, which routes into the
+    # runner watchdog (370), kubeclient transport (310+), the router,
+    # and the obs stack — so it sits below the whole transport tier
+    "shard.elastic": 280,
     # -- transport / web -----------------------------------------------
     "kubeclient.token_bucket": 310,
     "kubeclient.conn_pool": 320,
@@ -96,6 +100,7 @@ LOCK_HIERARCHY: dict[str, int] = {
     # -- persistence, innermost ----------------------------------------
     "persistence.snapshot_guard": 610,
     "wal.cv": 620,                  # group-commit condvar; leaf
+    "harness.diurnal_results": 630,  # conformance audit ledger; leaf
 }
 
 

@@ -557,6 +557,17 @@ class APIServer:
         self._run_snapshot()
         return True
 
+    def advance_rv_floor(self, rv: int) -> int:
+        """Raise the resourceVersion counter to at least ``rv`` (no-op
+        when already past). The elastic handoff calls this on a
+        recipient shard before copying a donor's range: every re-created
+        object then gets an rv ABOVE anything the donor ever issued for
+        it, so the router cache's per-object rv monotonicity keeps
+        accepting events for moved objects after the flip."""
+        with self._rv_lock:
+            self._rv = max(self._rv, int(rv))
+            return self._rv
+
     def close_persistence(self) -> None:
         if self._persistence is not None:
             self._persistence.close()

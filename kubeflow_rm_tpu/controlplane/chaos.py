@@ -68,6 +68,9 @@ FAULT_KINDS = (
     "checkpoint_fail",   # checkpoint write raises OSError
     "pod_kill",          # fake kubelet SIGKILLs one running pod
     "shard_kill",        # ShardRunner watchdog SIGKILLs one shard
+    "shard_split",       # SIGKILL the donor mid-split (tail-replay
+                         # must recover from the respawned donor's WAL
+                         # with zero loss)
 )
 
 
@@ -329,6 +332,22 @@ def pod_kill_victim(site: str, pod_names: list[str]) -> str | None:
     with plan._lock:
         n = plan.counts["pod_kill"]
     return sorted(pod_names)[n % len(pod_names)]
+
+
+def split_kill_fault(site: str) -> bool:
+    """Elastic-handoff choke point: one opportunity per split, drawn
+    between the bulk copy and the tail-replay loop (the window where a
+    donor death is most likely to lose the moving range). True tells
+    the coordinator to SIGKILL the donor; the watchdog respawns it
+    from its WAL and the tail-replay loop resumes against the
+    recovered log — the zero-loss assertion covers exactly this."""
+    plan = _plan
+    if plan is None:
+        return False
+    if plan._draw("shard_split", site) is None:
+        return False
+    plan._record("shard_split", site, defer_flight=False)
+    return True
 
 
 def shard_kill_victim(names: list[str]) -> str | None:

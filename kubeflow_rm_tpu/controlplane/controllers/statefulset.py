@@ -359,6 +359,13 @@ class StatefulSetController(Controller):
             self._schedule_gang(api, sts, gang, sched)
             return
         if not unbound:
+            # active-defrag arm: a settled reconcile is the cheap place
+            # to ask "is the pool fragmented enough to compact now?" —
+            # flag-gated no-op by default
+            from kubeflow_rm_tpu.controlplane import suspend
+            if suspend.active_defrag():
+                suspend.maybe_active_defrag(
+                    api, sched, allow_virtual=self._allow_virtual(api))
             return
         allow_virtual = self._allow_virtual(api)
         exclude = self._exclude_nodes(sts)

@@ -285,6 +285,21 @@ class RestServer:
             self._send(handler, 200,
                        {"writes": list(self.api.write_log)})
             return
+        if parsed.path == "/debug/rv_floor" and method == "POST":
+            # handoff recipients adopt the donor's rv horizon so the
+            # router cache's rv monotonicity survives the move
+            body = self._read_json(handler)
+            out = self.api.advance_rv_floor(int(body.get("rv", 0)))
+            self._send(handler, 200, {"rv": out})
+            return
+        if parsed.path == "/debug/snapshot" and method == "POST":
+            # force a compacting snapshot NOW: the elastic-shard
+            # handoff coordinator calls this on the donor before
+            # reading its WAL directory, so the bulk copy reads one
+            # snapshot file + a short tail instead of the full log
+            took = self.api.snapshot_now()
+            self._send(handler, 200, {"snapshotted": took})
+            return
         if parsed.path == "/debug/traces" and method == "GET":
             # this process's span collector, serialized — the metrics
             # service (and the sharded conformance harness) merges

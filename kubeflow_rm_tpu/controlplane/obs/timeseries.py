@@ -152,6 +152,13 @@ class TimeSeriesDB:
         ``/metrics`` and ingests the series with ``instance=name``."""
         self._scrapes[name] = url.rstrip("/")
 
+    def remove_scrape(self, name: str) -> None:
+        """Forget a shard (elastic merge retired it) — otherwise every
+        pass after the scale-down counts a scrape error against a
+        process that was deliberately stopped. Its historical series
+        age out of the window naturally."""
+        self._scrapes.pop(name, None)
+
     # ---- sampling ----------------------------------------------------
 
     def sample(self, now: float | None = None) -> int:

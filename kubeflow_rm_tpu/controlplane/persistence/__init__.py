@@ -32,7 +32,8 @@ from kubeflow_rm_tpu.controlplane.persistence.wal import (
     segment_paths,
 )
 
-__all__ = ["Persistence", "RecoveredState", "WALCorruption"]
+__all__ = ["Persistence", "RecoveredState", "WALCorruption",
+           "read_state", "tail_records"]
 
 log = logging.getLogger("kubeflow_rm_tpu.persistence")
 
@@ -54,6 +55,62 @@ def _key_of(obj: dict, cluster_scoped: set[str]) -> tuple:
     if kind in cluster_scoped:
         return (kind, None, meta.get("name"))
     return (kind, meta.get("namespace"), meta.get("name"))
+
+
+def read_state(dirpath: str, cluster_scoped: set[str]) -> RecoveredState:
+    """Read-only recovery: rebuild a shard's state from its WAL
+    directory WITHOUT opening the log for append. The elastic-shard
+    handoff coordinator runs this against a LIVE donor (the donor keeps
+    appending; we read snapshot + whatever closed-and-current segments
+    exist at this instant) — blind-upsert replay makes the torn tail
+    and any in-flight record harmless, and ``tail_records`` later
+    catches everything past ``rec.seq``."""
+    rec = RecoveredState()
+    doc = snap_mod.load_latest_snapshot(dirpath)
+    if doc:
+        rec.snapshot_seq = rec.seq = int(doc["seq"])
+        rec.rv = int(doc["rv"])
+        for obj in doc["objects"]:
+            rec.objects[_key_of(obj, cluster_scoped)] = obj
+    for seg in segment_paths(dirpath):
+        for record in iter_records(seg):
+            seq = int(record.get("seq", 0))
+            if seq <= rec.snapshot_seq:
+                continue
+            rec.seq = max(rec.seq, seq)
+            rec.rv = max(rec.rv, int(record.get("rv", 0)))
+            obj = record.get("obj")
+            if obj is None:
+                continue
+            key = _key_of(obj, cluster_scoped)
+            if record.get("verb") == "DELETE":
+                rec.objects.pop(key, None)
+            else:
+                rec.objects[key] = obj
+            rec.records_replayed += 1
+    return rec
+
+
+def tail_records(dirpath: str, after_seq: int) -> list[dict]:
+    """Every WAL record with ``seq > after_seq``, in seq order — the
+    tail-replay feed for a live handoff. Re-reads the segment files on
+    every call (the donor appends concurrently); a torn tail ends a
+    segment silently, exactly like boot replay.
+
+    Compaction race: a snapshot the donor takes BETWEEN passes unlinks
+    segments, folding their records into the snapshot file — records
+    in ``(after_seq, snapshot_seq]`` are then invisible here. The
+    handoff coordinator guards against this by checking the donor's
+    ``snapshot_seq`` (``load_latest_snapshot``) each pass and falling
+    back to a full :func:`read_state` + state diff when it advanced
+    past its replay horizon."""
+    out: list[dict] = []
+    for seg in segment_paths(dirpath):
+        for record in iter_records(seg):
+            if int(record.get("seq", 0)) > after_seq:
+                out.append(record)
+    out.sort(key=lambda r: int(r.get("seq", 0)))
+    return out
 
 
 class Persistence:

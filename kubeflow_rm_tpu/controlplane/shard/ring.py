@@ -4,10 +4,22 @@ Deterministic across processes (md5, no seed): the router in the
 client, every shard worker, and the conformance harness all compute
 the same ``shard_for(namespace)`` with no coordination. Virtual nodes
 smooth the partition (#vnodes ≫ #shards keeps the largest shard within
-a few percent of fair share); membership is fixed for a deployment —
-a restarted shard rejoins under the same name at the same position, so
-"retry-with-remap" on the client resolves to the same shard once it is
-back (remap matters when a deployment is later resized).
+a few percent of fair share); a restarted shard rejoins under the same
+name at the same position, so "retry-with-remap" on the client
+resolves to the same shard once it is back.
+
+Elastic membership (split/merge): ``with_member`` / ``without_member``
+derive the NEXT ring from this one without mutating it — the handoff
+coordinator computes the moved key-set against both rings, copies
+state, and only then flips the router to the new ring, so routing is
+never observed mid-rebuild. ``moved_keys`` is the range-ownership
+delta that drives a handoff; consistent hashing bounds it to roughly
+1/N of the keyspace per membership change.
+
+Pins: an explicit ``key -> member`` override consulted before the
+hash. Cross-shard notebook migration moves ONE namespace to a chosen
+target (not where the hash puts it); the pin makes that routing
+deterministic for every client that shares the pin map.
 
 Partition key: a namespaced object's namespace; a cluster-scoped
 object's NAME (Profile "alice" and Namespace "alice" hash identically,
@@ -29,10 +41,17 @@ def _hash(key: str) -> int:
 
 class HashRing:
     def __init__(self, members: list[str], *,
-                 vnodes: int = DEFAULT_VNODES):
+                 vnodes: int = DEFAULT_VNODES,
+                 pins: dict[str, str] | None = None):
         if not members:
             raise ValueError("HashRing needs at least one member")
         self.members = sorted(members)
+        self.vnodes = vnodes
+        self.pins = dict(pins or {})
+        for key, owner in self.pins.items():
+            if owner not in self.members:
+                raise ValueError(
+                    f"pin {key!r} -> {owner!r}: not a ring member")
         self._points: list[int] = []
         self._owners: list[str] = []
         pairs = sorted(
@@ -46,6 +65,16 @@ class HashRing:
         """The member owning ``key`` (a namespace, or a cluster-scoped
         object's name). ``None`` — e.g. a cluster-wide list — is the
         caller's cue to fan out, but routes deterministically here."""
+        pinned = self.pins.get(key or "")
+        if pinned is not None:
+            return pinned
+        i = bisect.bisect_right(self._points, _hash(key or "")) \
+            % len(self._points)
+        return self._owners[i]
+
+    def hash_owner(self, key: str | None) -> str:
+        """Where the hash alone puts ``key``, ignoring pins — a pin
+        whose target matches this is redundant and can be dropped."""
         i = bisect.bisect_right(self._points, _hash(key or "")) \
             % len(self._points)
         return self._owners[i]
@@ -55,6 +84,56 @@ class HashRing:
         out: dict[str, list[str]] = {m: [] for m in self.members}
         for k in keys:
             out[self.shard_for(k)].append(k)
+        return out
+
+    # ---- elastic membership ------------------------------------------
+    def with_member(self, name: str) -> "HashRing":
+        """The ring after a split admits ``name``. Pins survive (their
+        targets are all still members)."""
+        if name in self.members:
+            raise ValueError(f"{name!r} already a ring member")
+        return HashRing(self.members + [name], vnodes=self.vnodes,
+                        pins=self.pins)
+
+    def without_member(self, name: str,
+                       drop_pins: bool = True) -> "HashRing":
+        """The ring after a merge retires ``name``. Pins targeting the
+        leaving member are dropped (their keys fall back to the hash
+        and ride the merge handoff like any other key)."""
+        if name not in self.members:
+            raise ValueError(f"{name!r} not a ring member")
+        rest = [m for m in self.members if m != name]
+        if not rest:
+            raise ValueError("cannot remove the last ring member")
+        pins = {k: o for k, o in self.pins.items() if o != name}
+        if not drop_pins and len(pins) != len(self.pins):
+            raise ValueError(f"pins still target {name!r}")
+        return HashRing(rest, vnodes=self.vnodes, pins=pins)
+
+    def with_pin(self, key: str, member: str) -> "HashRing":
+        """The ring with ``key`` explicitly owned by ``member``. A pin
+        matching the hash owner is stored anyway — callers may drop it
+        later via ``with_pin``'s inverse (``without_pin``)."""
+        if member not in self.members:
+            raise ValueError(f"{member!r} not a ring member")
+        pins = dict(self.pins)
+        pins[key] = member
+        return HashRing(self.members, vnodes=self.vnodes, pins=pins)
+
+    def without_pin(self, key: str) -> "HashRing":
+        pins = dict(self.pins)
+        pins.pop(key, None)
+        return HashRing(self.members, vnodes=self.vnodes, pins=pins)
+
+    def moved_keys(self, new: "HashRing", keys) -> dict[str, tuple]:
+        """The ownership delta driving a handoff: key ->
+        (old_owner, new_owner) for every key whose owner changes
+        between ``self`` and ``new``."""
+        out: dict[str, tuple] = {}
+        for k in keys:
+            a, b = self.shard_for(k), new.shard_for(k)
+            if a != b:
+                out[k] = (a, b)
         return out
 
     def __len__(self) -> int:

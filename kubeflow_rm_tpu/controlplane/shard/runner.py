@@ -45,29 +45,45 @@ class ShardRunner:
         self._ctx = multiprocessing.get_context("spawn")
         self._procs: dict[str, multiprocessing.process.BaseProcess] = {}
         self._cfgs: dict[str, dict] = {}
+        # retired (merged-away) shards keep their cfg so the handoff
+        # coordinator can still resolve ``wal_dir(name)`` post-merge
+        self._retired: dict[str, dict] = {}
+        # the intentional-shutdown handshake: names whose next exit is
+        # a deliberate scale-down, NOT a death — the watchdog must not
+        # count it, alert on it, or respawn it
+        self._expected: set[str] = set()
+        self._next_index = n_shards
         self._stopping = False
         self._lock = make_lock("shard.watchdog")
         self._supervise = supervise
+        self._base_dir = base_dir
+        self._wal = wal
+        self._template = {
+            "manager_workers": manager_workers,
+            "auto_ready": auto_ready, "hang_dump_s": hang_dump_s,
+            "tracing": tracing,
+        }
         # flight-recorder hook: ``on_death(name, exitcode)`` fires from
         # the watchdog thread AFTER the respawn is issued, so the
         # callback (which may scrape /metrics, dump bundles, ...) never
         # delays recovery
         self._on_death = on_death
         for i in range(n_shards):
-            name = f"shard-{i}"
-            wal_dir = None
-            if wal:
-                wal_dir = os.path.join(
-                    base_dir or ".", "wal", name)
-                os.makedirs(wal_dir, exist_ok=True)
-            self._cfgs[name] = {
-                "name": name, "port": _free_port(), "wal_dir": wal_dir,
-                "manager_workers": manager_workers,
-                "auto_ready": auto_ready, "hang_dump_s": hang_dump_s,
-                # span collection in the worker: a respawned shard
-                # re-reads this, so the tracing arm survives chaos kills
-                "tracing": tracing,
-            }
+            self._make_cfg(f"shard-{i}")
+
+    def _make_cfg(self, name: str) -> dict:
+        wal_dir = None
+        if self._wal:
+            wal_dir = os.path.join(self._base_dir or ".", "wal", name)
+            os.makedirs(wal_dir, exist_ok=True)
+        cfg = {
+            "name": name, "port": _free_port(), "wal_dir": wal_dir,
+            # span collection in the worker: a respawned shard
+            # re-reads this, so the tracing arm survives chaos kills
+            **self._template,
+        }
+        self._cfgs[name] = cfg
+        return cfg
 
     # ---- topology ----------------------------------------------------
     @property
@@ -80,7 +96,8 @@ class ShardRunner:
                 for n, c in self._cfgs.items()}
 
     def wal_dir(self, name: str) -> str | None:
-        return self._cfgs[name]["wal_dir"]
+        cfg = self._cfgs.get(name) or self._retired[name]
+        return cfg["wal_dir"]
 
     def liveness(self) -> dict[str, bool]:
         """Per-shard aliveness as the supervisor sees it — the flight
@@ -151,6 +168,54 @@ class ShardRunner:
             self._spawn(name)
         self.wait_ready(timeout, names=[name])
 
+    # ---- elastic membership (split / merge) --------------------------
+    def add_shard(self, name: str | None = None,
+                  timeout: float = 60.0) -> str:
+        """Spawn one NEW shard (the split recipient): fresh name, fresh
+        port, fresh (empty) WAL directory. Health-waited; the caller
+        copies state into it and flips the ring afterwards."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        with self._lock:
+            if name is None:
+                name = f"shard-{self._next_index}"
+                self._next_index += 1
+            elif name in self._cfgs:
+                raise ValueError(f"shard {name!r} already exists")
+            if name in self._retired:
+                # a re-admitted name must not replay its old store
+                raise ValueError(f"shard {name!r} was retired; "
+                                 "elastic names are never reused")
+            cfg = self._make_cfg(name)
+            metrics.SHARD_DEATHS_TOTAL.labels(shard=name)
+            self._spawn(name)
+        self.wait_ready(timeout, names=[name])
+        log.info("elastic: added %s on port %d", name, cfg["port"])
+        return name
+
+    def remove_shard(self, name: str, timeout: float = 30.0) -> None:
+        """Retire one shard DELIBERATELY (the merge donor, after its
+        range has been handed off). The intentional-shutdown handshake:
+        the name goes into ``_expected`` under the watchdog's own lock
+        BEFORE the SIGTERM, so the watchdog never mistakes this exit
+        for a death — no ``shard_deaths_total`` increment, no
+        shard-death critical alert, no respawn. SIGTERM (not SIGKILL)
+        lets the worker flush + close its WAL cleanly."""
+        with self._lock:
+            if name not in self._cfgs:
+                raise KeyError(f"no shard {name!r}")
+            self._expected.add(name)
+            p = self._procs.pop(name, None)
+            self._retired[name] = self._cfgs.pop(name)
+        if p is not None and p.is_alive():
+            p.terminate()
+            p.join(timeout=timeout)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=5)
+        with self._lock:
+            self._expected.discard(name)
+        log.info("elastic: retired %s", name)
+
     def _watchdog(self) -> None:
         from kubeflow_rm_tpu.controlplane import chaos, metrics
         while not self._stopping:
@@ -159,7 +224,8 @@ class ShardRunner:
             # tick; the kill lands through the same ``kill`` verb the
             # explicit chaos test uses, and this very loop observes the
             # death and respawns in place
-            alive = [n for n, p in self._procs.items() if p.is_alive()]
+            alive = [n for n, p in self._procs.items()
+                     if p.is_alive() and n not in self._expected]
             victim = chaos.shard_kill_victim(alive)
             if victim is not None and not self._stopping:
                 log.warning("chaos: SIGKILLing %s", victim)
@@ -170,6 +236,10 @@ class ShardRunner:
             for name, p in list(self._procs.items()):
                 if self._stopping or p.is_alive():
                     continue
+                if name in self._expected:
+                    # intentional-shutdown handshake: a deliberate
+                    # scale-down in flight — not a death
+                    continue
                 exitcode = p.exitcode
                 log.warning("%s exited (code %s); respawning in place",
                             name, exitcode)
@@ -177,6 +247,7 @@ class ShardRunner:
                 respawned = False
                 with self._lock:
                     if not self._stopping and \
+                            name in self._procs and \
                             not self._procs[name].is_alive():
                         self._spawn(name)
                         respawned = True

@@ -96,6 +96,22 @@ def shard_worker_main(cfg: dict) -> None:
     threading.Thread(target=kubelet.run_forever, args=(stop, 0.05),
                      kwargs={"workers": 4}, daemon=True).start()
 
+    # a deliberate scale-down (ShardRunner.remove_shard) SIGTERMs us:
+    # flush + close the WAL so the merge coordinator reads a cleanly
+    # closed log (SIGKILL stays crash-consistent via group commit —
+    # this handler is an optimization, not a correctness requirement)
+    import os as _os
+    import signal as _signal
+
+    def _graceful_exit(signum, frame):
+        stop.set()
+        try:
+            capi.close_persistence()
+        finally:
+            _os._exit(0)
+
+    _signal.signal(_signal.SIGTERM, _graceful_exit)
+
     rest = RestServer(capi, port=cfg["port"])
     rest.start()
 

@@ -925,6 +925,97 @@ def try_compact_migration(api: APIServer, sts: dict,
     return
 
 
+# ---- active fragmentation-driven defrag (scheduler policy arm) -------
+# r11 added the fragmentation gauge; r15 made compaction migration a
+# LAST RESORT (only when a gang already failed to bind). This promotes
+# it to an ACTIVE placement policy: whenever fragmentation crosses the
+# threshold, proactively migrate the cheapest victim whose removal
+# grows the largest contiguous free block — so the next gang arrival
+# finds contiguous capacity instead of paying the migrate-under-
+# pressure latency. Off by default; the conformance A/B arm
+# (--active-defrag) measures both sides.
+
+_active_defrag = False
+ACTIVE_DEFRAG_FRAGMENTATION = 0.5
+
+
+def set_active_defrag(enabled: bool) -> None:
+    global _active_defrag
+    _active_defrag = bool(enabled)
+
+
+def active_defrag() -> bool:
+    return _active_defrag
+
+
+def maybe_active_defrag(api: APIServer,
+                        sched: "scheduler.SchedulerCache", *,
+                        allow_virtual: bool = False) -> bool:
+    """One proactive compaction step, threshold-gated. Returns True if
+    a migration was initiated. Reuses the last-resort machinery's
+    victim model and in-flight guard (at most one migration cluster-
+    wide), but is driven by the fragmentation gauge alone — no waiting
+    gang required. The victim must (a) grow the largest contiguous
+    free block and (b) plausibly re-land elsewhere (its biggest pod
+    fits on some node it does not currently occupy), so defrag never
+    evicts a slice into indefinite parking."""
+    if not _active_defrag or not oversubscribe() \
+            or scheduler.legacy_scan():
+        return False
+    stats = sched.stats()
+    if stats["free_chips"] <= 0 \
+            or stats["fragmentation"] < ACTIVE_DEFRAG_FRAGMENTATION:
+        return False
+    scan = getattr(api, "scan", api.list)
+    candidates: list[_Victim] = []
+    for nb in scan(nb_api.KIND):
+        ann = annotations_of(nb)
+        if nb_api.MIGRATE_REQUESTED_ANNOTATION in ann:
+            return False  # one migration in flight: let it land
+        if (nb["metadata"].get("deletionTimestamp")
+                or nb_api.SUSPEND_ANNOTATION in ann
+                or nb_api.STOP_ANNOTATION in ann
+                or nb_api.RESUME_REQUESTED_ANNOTATION in ann
+                or nb_api.is_pinned(nb)):
+            continue
+        name, ns = name_of(nb), namespace_of(nb)
+        pods = [p for p in scan("Pod", ns)
+                if (p["metadata"].get("labels") or {}).get(
+                    nb_api.NOTEBOOK_NAME_LABEL) == name
+                and deep_get(p, "spec", "nodeName")
+                and deep_get(p, "status", "phase")
+                not in scheduler.TERMINAL_PHASES]
+        v = _Victim(nb, pods, nb_api.priority_of(nb), "")
+        if v.chips:
+            candidates.append(v)
+    candidates.sort(key=lambda v: (v.chips, name_of(v.notebook)))
+    by_node = sched.free_by_node()
+    free = {node: f for node, (f, _labels) in by_node.items()}
+    cur_block = max(free.values(), default=0.0)
+    for v in candidates:
+        grown = dict(free)
+        for node, c in v.per_node.items():
+            grown[node] = grown.get(node, 0.0) + c
+        if max(grown.values(), default=0.0) <= cur_block:
+            continue  # moving it wouldn't consolidate anything
+        biggest_pod = max(
+            (scheduler._pod_chips(p) for p in v.pods), default=0.0)
+        elsewhere = max(
+            (f for node, f in free.items() if node not in v.per_node),
+            default=0.0)
+        if elsewhere < biggest_pod and not allow_virtual:
+            continue  # nowhere to re-land: would park, not defrag
+        api.record_event(
+            v.notebook, "Normal", "ActiveDefrag",
+            f"fragmentation {stats['fragmentation']:.2f} >= "
+            f"{ACTIVE_DEFRAG_FRAGMENTATION}: proactively migrating "
+            f"{name_of(v.notebook)} ({v.chips:.0f} chips) off "
+            f"{sorted(v.per_node)} to consolidate free capacity")
+        initiate_migration(api, v.notebook, trigger="fragmentation")
+        return True
+    return False
+
+
 # ---- preemptive gang-bind --------------------------------------------
 
 class _Victim:

@@ -1,0 +1,442 @@
+"""Elastic shard layer: live split/merge with WAL-replay handoff.
+
+Uses an in-thread fleet fake — one ``APIServer`` (WAL-backed) behind a
+``RestServer`` per shard, same surface ``ShardRunner`` offers the
+coordinator (``urls`` / ``wal_dir`` / ``add_shard`` / ``remove_shard``
+/ ``kill``) — so the handoff protocol, fence, rv-floor, chaos arm, and
+autoscaler policy are all exercised without process topology. The real
+multi-process day is conformance/spawn_conformance.py ``--diurnal``.
+"""
+
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import chaos
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+    ShardedKubeAPIServer,
+)
+from kubeflow_rm_tpu.controlplane.deploy.restserver import RestServer
+from kubeflow_rm_tpu.controlplane.metrics import registry_value
+from kubeflow_rm_tpu.controlplane.shard.elastic import (
+    ElasticShardManager,
+    ShardAutoscaler,
+    partition_key,
+)
+from kubeflow_rm_tpu.controlplane.shard.ring import HashRing
+
+
+class _Fleet:
+    """In-thread ShardRunner stand-in: fixed port + WAL dir per shard,
+    ``kill`` respawns from the WAL at the same port (what the real
+    watchdog does, minus the process boundary)."""
+
+    def __init__(self, base_dir: str, n: int = 2):
+        self.base = base_dir
+        self.apis: dict[str, APIServer] = {}
+        self.rests: dict[str, RestServer] = {}
+        self._urls: dict[str, str] = {}
+        self._next = n
+        for i in range(n):
+            self._boot(f"shard-{i}")
+
+    def _boot(self, name: str, port: int | None = None) -> str:
+        wal = self.wal_dir(name)
+        os.makedirs(wal, exist_ok=True)
+        api = APIServer(shard=name, wal_dir=wal, wal_fsync=False)
+        rest = RestServer(api, port=port) if port else RestServer(api)
+        rest.start()
+        self.apis[name] = api
+        self.rests[name] = rest
+        self._urls[name] = rest.url
+        return name
+
+    @property
+    def urls(self) -> dict[str, str]:
+        return dict(self._urls)
+
+    def wal_dir(self, name: str) -> str:
+        return os.path.join(self.base, "wal", name)
+
+    def add_shard(self, name: str | None = None,
+                  timeout: float = 60.0) -> str:
+        name = name or f"shard-{self._next}"
+        self._next += 1
+        return self._boot(name)
+
+    def remove_shard(self, name: str, timeout: float = 30.0) -> None:
+        self.rests.pop(name).stop()
+        self.apis.pop(name).close_persistence()
+        self._urls.pop(name)
+
+    def kill(self, name: str) -> int:
+        port = int(self._urls[name].rsplit(":", 1)[1])
+        self.rests[name].stop()  # no WAL close: a SIGKILL never flushes
+        self._boot(name, port=port)
+        return port
+
+    def stop(self) -> None:
+        for rest in self.rests.values():
+            rest.stop()
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    f = _Fleet(str(tmp_path), n=2)
+    yield f
+    f.stop()
+
+
+def _pod(name: str, ns: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"containers": [{"name": "c", "image": "img"}]}}
+
+
+def _seed(router, n_ns: int = 12, pods_per: int = 3) -> list[str]:
+    spaces = [f"el-{i}" for i in range(n_ns)]
+    for ns in spaces:
+        router.ensure_namespace(ns)
+        for j in range(pods_per):
+            router.create(_pod(f"p-{j}", ns))
+    return spaces
+
+
+def _audit(router, fleet, spaces, pods_per: int = 3) -> None:
+    """Zero-loss + placement invariant: every object reads back through
+    the router AND physically lives on (only) its ring owner."""
+    for ns in spaces:
+        owner = router.shard_of("Pod", None, ns)
+        for j in range(pods_per):
+            assert router.get("Pod", f"p-{j}", ns) is not None
+            assert fleet.apis[owner].try_get("Pod", f"p-{j}", ns) \
+                is not None, (ns, owner)
+            for other, api in fleet.apis.items():
+                if other != owner:
+                    assert api.try_get("Pod", f"p-{j}", ns) is None, \
+                        (ns, other)
+
+
+# ---- split -----------------------------------------------------------
+
+def test_split_hands_off_range_with_zero_loss(fleet):
+    router = ShardedKubeAPIServer(fleet.urls)
+    elastic = ElasticShardManager(fleet, router)
+    spaces = _seed(router)
+    before = dict(router.ring.spread(spaces))
+
+    new = elastic.split()
+    assert new in router.ring.members and len(router.ring) == 3
+    # the new member actually took a slice of the keyspace
+    moved = [ns for ns in spaces
+             if router.shard_of("Pod", None, ns) == new]
+    assert moved, before
+    _audit(router, fleet, spaces)
+    # unmoved namespaces never left their shard
+    for ns in spaces:
+        if ns not in moved:
+            assert HashRing(["shard-0", "shard-1"]).shard_for(ns) == \
+                router.shard_of("Pod", None, ns)
+
+
+def test_split_replicates_broadcast_kinds_to_new_shard(fleet):
+    router = ShardedKubeAPIServer(fleet.urls)
+    elastic = ElasticShardManager(fleet, router)
+    router.create({"apiVersion": "rbac.authorization.k8s.io/v1",
+                   "kind": "ClusterRole",
+                   "metadata": {"name": "admin-all"}, "rules": []})
+    new = elastic.split()
+    assert fleet.apis[new].try_get("ClusterRole", "admin-all") \
+        is not None
+    assert len(router.list("ClusterRole")) == 1
+
+
+def test_writes_during_split_are_never_lost(fleet):
+    router = ShardedKubeAPIServer(fleet.urls, retry_window_s=10.0)
+    elastic = ElasticShardManager(fleet, router)
+    spaces = _seed(router, n_ns=8, pods_per=1)
+    written: list[tuple] = []
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            ns = spaces[i % len(spaces)]
+            router.create(_pod(f"w-{i}", ns))
+            written.append((ns, f"w-{i}"))
+            i += 1
+            time.sleep(0.005)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        elastic.split()
+        time.sleep(0.1)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    # every acked write — before, during, or after the flip — reads
+    # back through the router AND from its current ring owner
+    assert written
+    for ns, name in written:
+        assert router.get("Pod", name, ns) is not None
+        owner = router.shard_of("Pod", None, ns)
+        assert fleet.apis[owner].try_get("Pod", name, ns) is not None
+
+
+def test_split_survives_donor_sigkill_mid_handoff(fleet):
+    """The ``shard_split`` chaos arm: the donor dies between the bulk
+    copy and the tail chase; recovery (respawn + WAL replay + more
+    tail passes) must still deliver zero loss."""
+    router = ShardedKubeAPIServer(fleet.urls, retry_window_s=10.0)
+    elastic = ElasticShardManager(fleet, router)
+    spaces = _seed(router)
+    plan = chaos.FaultPlan(1234, [
+        chaos.FaultSpec("shard_split", rate=1.0, limit=1)])
+    chaos.install(plan)
+    try:
+        elastic.split()
+    finally:
+        chaos.uninstall()
+    assert plan.counts.get("shard_split") == 1, plan.summary()
+    _audit(router, fleet, spaces)
+
+
+# ---- merge -----------------------------------------------------------
+
+def test_merge_retires_youngest_and_keeps_everything(fleet):
+    router = ShardedKubeAPIServer(fleet.urls)
+    elastic = ElasticShardManager(fleet, router)
+    spaces = _seed(router)
+    grown = elastic.split()
+    _audit(router, fleet, spaces)
+
+    victim = elastic.merge()
+    assert victim == grown  # scale-down unwinds scale-up
+    assert victim not in router.ring.members
+    assert victim not in fleet.apis  # process actually retired
+    _audit(router, fleet, spaces)
+
+
+def test_merge_below_min_refuses(fleet):
+    router = ShardedKubeAPIServer(fleet.urls)
+    elastic = ElasticShardManager(fleet, router)
+    elastic.merge()
+    with pytest.raises(ValueError):
+        elastic.merge()
+
+
+# ---- pinned migration ------------------------------------------------
+
+def test_migrate_namespace_pins_and_moves(fleet):
+    router = ShardedKubeAPIServer(fleet.urls)
+    elastic = ElasticShardManager(fleet, router)
+    ns = "pinned-ns"
+    router.ensure_namespace(ns)
+    router.create(_pod("p-0", ns))
+    home = router.shard_of("Pod", None, ns)
+    target = next(m for m in router.ring.members if m != home)
+
+    assert elastic.migrate_namespace(ns, target) is True
+    assert router.shard_of("Pod", None, ns) == target
+    assert router.ring.pins.get(ns) == target
+    assert fleet.apis[target].try_get("Pod", "p-0", ns) is not None
+    assert fleet.apis[home].try_get("Pod", "p-0", ns) is None
+    # idempotent: already there
+    assert elastic.migrate_namespace(ns, target) is False
+    # routing for OTHER keys is untouched by the pin
+    assert router.ring.hash_owner(ns) == home
+
+
+def test_partition_key_mirrors_router_rule():
+    assert partition_key("Pod", "p", "ns1") == "ns1"
+    assert partition_key("Profile", "alice", None) == "alice"
+    assert partition_key("Namespace", "alice", None) == "alice"
+
+
+# ---- autoscaler policy (fakes: policy only, no fleet) ----------------
+
+class _FakeTSDB:
+    def __init__(self):
+        self.depth: dict[str, float] = {}
+        self.scrapes: dict[str, str] = {}
+
+    def latest(self, name, labels=None):
+        return self.depth.get((labels or {}).get("instance"))
+
+    def add_scrape(self, name, url):
+        self.scrapes[name] = url
+
+    def remove_scrape(self, name):
+        self.scrapes.pop(name, None)
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.states: dict[str, str] = {}
+
+    def state_of(self, name):
+        return self.states[name]  # KeyError for unknown, like the real
+
+
+class _FakeElastic:
+    def __init__(self, n=2):
+        self.router = SimpleNamespace(
+            ring=HashRing([f"shard-{i}" for i in range(n)]))
+        self.calls: list[str] = []
+        self._next = n
+
+    def split(self):
+        self.calls.append("split")
+        name = f"shard-{self._next}"
+        self._next += 1
+        self.router.ring = self.router.ring.with_member(name)
+        return name
+
+    def merge(self):
+        self.calls.append("merge")
+        victim = self.router.ring.members[-1]
+        self.router.ring = self.router.ring.without_member(victim)
+        return victim
+
+
+def _scaler(n=2, **kw):
+    fake = _FakeElastic(n)
+    obs = SimpleNamespace(tsdb=_FakeTSDB(), engine=_FakeEngine())
+    kw.setdefault("sustain", 3)
+    kw.setdefault("cooldown_s", 0.0)
+    return ShardAutoscaler(fake, obs, **kw), fake, obs
+
+
+def test_autoscaler_splits_on_sustained_depth():
+    scaler, fake, obs = _scaler()
+    for s in fake.router.ring.members:
+        obs.tsdb.depth[s] = 50.0
+    assert [scaler.tick(i) for i in range(3)] == \
+        ["hold", "hold", "split"]
+    assert fake.calls == ["split"]
+
+
+def test_autoscaler_one_spike_does_not_split():
+    scaler, fake, obs = _scaler()
+    obs.tsdb.depth = {s: 50.0 for s in fake.router.ring.members}
+    scaler.tick(0)
+    obs.tsdb.depth = {s: 3.0 for s in fake.router.ring.members}
+    for i in range(1, 6):
+        scaler.tick(i)
+    assert fake.calls == []
+
+
+def test_autoscaler_merges_on_sustained_idle_to_min():
+    scaler, fake, obs = _scaler(n=3)
+    obs.tsdb.depth = {s: 0.0 for s in fake.router.ring.members}
+    decisions = [scaler.tick(i) for i in range(8)]
+    assert "merge" in decisions
+    assert len(fake.router.ring) == 2  # floor: min_shards
+    assert fake.calls.count("merge") == 1
+
+
+def test_autoscaler_slo_burn_counts_as_pressure():
+    """Critical burn + a sub-split-threshold queue still splits: the
+    fleet is struggling with the work it has. But critical burn over
+    an EMPTY queue is window residue from drained traffic — it must
+    not hold capacity up (or the fleet could never merge overnight,
+    burn windows being longer than any idle gap)."""
+    scaler, fake, obs = _scaler()
+    obs.engine.states["provision-p50"] = "critical"
+    obs.tsdb.depth = {s: 3.0 for s in fake.router.ring.members}
+    for i in range(3):
+        scaler.tick(i)
+    assert fake.calls == ["split"]
+
+    scaler2, fake2, obs2 = _scaler(n=3)
+    obs2.engine.states["provision-p50"] = "critical"
+    obs2.tsdb.depth = {s: 0.0 for s in fake2.router.ring.members}
+    for i in range(3):
+        scaler2.tick(i)
+    assert fake2.calls == ["merge"]  # stale burn does not pin 3 wide
+
+
+def test_autoscaler_respects_cooldown_and_max():
+    scaler, fake, obs = _scaler(max_shards=3, cooldown_s=3600.0)
+    obs.tsdb.depth = {s: 50.0 for s in fake.router.ring.members}
+    decisions = [scaler.tick(i) for i in range(8)]
+    assert decisions.count("split") == 1  # cooldown holds the second
+    assert "cooldown" in decisions
+    assert len(fake.router.ring) == 3
+
+
+# ---- watchdog interplay (satellite: intentional-shutdown) ------------
+
+class _FakeProc:
+    def __init__(self):
+        self.alive = True
+        self.exitcode = None
+
+    def is_alive(self):
+        return self.alive
+
+    def terminate(self):
+        self.alive, self.exitcode = False, -15
+
+    def kill(self):
+        self.alive, self.exitcode = False, -9
+
+    def join(self, timeout=None):
+        pass
+
+
+def test_deliberate_remove_is_not_a_death(monkeypatch):
+    """A merge's ``remove_shard`` must not increment
+    ``shard_deaths_total``, and the watchdog must not respawn the
+    retired shard — while a REAL death on another shard still gets
+    counted and respawned by the same loop."""
+    from kubeflow_rm_tpu.controlplane.shard.runner import ShardRunner
+    runner = ShardRunner(2, wal=False, supervise=False)
+    respawned: list[str] = []
+    monkeypatch.setattr(runner, "_spawn",
+                        lambda name: respawned.append(name))
+    procs = {n: _FakeProc() for n in ("shard-0", "shard-1")}
+    runner._procs.update(procs)
+
+    deaths_before = {
+        n: registry_value("shard_deaths_total", {"shard": n}) or 0.0
+        for n in procs}
+    wd = threading.Thread(target=runner._watchdog, daemon=True)
+    wd.start()
+    try:
+        runner.remove_shard("shard-1")
+        time.sleep(0.6)  # several watchdog ticks
+        assert respawned == []
+        assert (registry_value("shard_deaths_total",
+                               {"shard": "shard-1"}) or 0.0) == \
+            deaths_before["shard-1"]
+        assert "shard-1" not in runner.names
+        assert runner.wal_dir("shard-1") is None  # retired cfg kept
+
+        # a genuine death on the survivor IS a death
+        procs["shard-0"].alive, procs["shard-0"].exitcode = False, -9
+        deadline = time.monotonic() + 5
+        while "shard-0" not in respawned and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert respawned == ["shard-0"]
+        assert registry_value("shard_deaths_total",
+                              {"shard": "shard-0"}) == \
+            deaths_before["shard-0"] + 1
+    finally:
+        runner._stopping = True
+        wd.join(timeout=5)
+
+
+def test_retired_names_are_never_reused():
+    from kubeflow_rm_tpu.controlplane.shard.runner import ShardRunner
+    runner = ShardRunner(2, wal=False, supervise=False)
+    runner._procs["shard-1"] = _FakeProc()
+    runner.remove_shard("shard-1")
+    with pytest.raises(ValueError, match="never reused"):
+        runner.add_shard("shard-1")

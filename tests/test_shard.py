@@ -48,6 +48,78 @@ def test_ring_remap_is_minimal_on_resize():
     assert moved < 500
 
 
+def test_ring_with_member_moves_only_new_owners_keys():
+    """Elastic split invariant: deriving ``with_member`` moves a key
+    iff the NEW member claims it — no key migrates between survivors,
+    and the derived ring routes identically to a fresh construction."""
+    before = HashRing(["s0", "s1", "s2"])
+    after = before.with_member("s3")
+    fresh = HashRing(["s0", "s1", "s2", "s3"])
+    keys = [f"ns-{i}" for i in range(1000)]
+    moved = before.moved_keys(after, keys)
+    assert moved  # the new member takes a non-empty slice
+    for key in keys:
+        assert after.shard_for(key) == fresh.shard_for(key)
+        if key in moved:
+            old, new = moved[key]
+            assert new == "s3" and old != "s3"
+        else:
+            assert before.shard_for(key) == after.shard_for(key)
+    # consistent-hash bound: ~1/4 of the keyspace, not a reshuffle
+    assert len(moved) < 500
+
+
+def test_ring_without_member_moves_only_departing_keys():
+    before = HashRing(["s0", "s1", "s2", "s3"])
+    after = before.without_member("s3")
+    keys = [f"ns-{i}" for i in range(1000)]
+    moved = before.moved_keys(after, keys)
+    for key in keys:
+        if before.shard_for(key) == "s3":
+            assert key in moved  # every orphan re-homes
+            assert moved[key][1] in ("s0", "s1", "s2")
+        else:
+            assert key not in moved  # survivors keep their ranges
+    # split-then-merge round-trips routing exactly
+    grown = after.with_member("s3")
+    for key in keys:
+        assert grown.shard_for(key) == before.shard_for(key)
+
+
+def test_ring_membership_derivation_validates():
+    ring = HashRing(["s0", "s1"])
+    with pytest.raises(ValueError):
+        ring.with_member("s0")  # already a member
+    with pytest.raises(ValueError):
+        ring.without_member("nope")
+    with pytest.raises(ValueError):
+        HashRing(["s0"]).without_member("s0")  # never below one
+    # derivation is immutable: the source ring is untouched
+    ring.with_member("s2")
+    ring.without_member("s1")
+    assert ring.members == ["s0", "s1"]
+
+
+def test_ring_pins_override_hash_and_die_with_their_target():
+    ring = HashRing(["s0", "s1", "s2"])
+    key = next(f"ns-{i}" for i in range(100)
+               if ring.shard_for(f"ns-{i}") == "s0")
+    pinned = ring.with_pin(key, "s2")
+    assert pinned.shard_for(key) == "s2"
+    assert pinned.hash_owner(key) == "s0"  # hash placement unchanged
+    # only the pinned key moved
+    assert ring.moved_keys(pinned,
+                           [f"ns-{i}" for i in range(100)]) == \
+        {key: ("s0", "s2")}
+    # retiring the pin's target drops the pin: the key falls back to
+    # its hash owner instead of routing to a dead shard
+    after = pinned.without_member("s2")
+    assert key not in after.pins
+    assert after.shard_for(key) == after.hash_owner(key)
+    with pytest.raises(ValueError):
+        ring.with_pin(key, "not-a-member")
+
+
 # ---- router over an in-thread 2-shard stack --------------------------
 
 class _Stack:

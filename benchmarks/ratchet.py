@@ -105,6 +105,12 @@ def _serve_metrics(artifact: dict) -> dict[str, float]:
         p95 = sec.get("victim_p95_ms_worst")
         if p95 is not None:
             out[f"{arm}.victim_p95_ms"] = float(p95)
+        # disagg_storm arms carry an aggregate interactive p95 (the
+        # SLO-class latency the prefill/decode split is meant to
+        # protect) alongside the per-tenant worst
+        p95i = sec.get("interactive_p95_ms")
+        if p95i is not None:
+            out[f"{arm}.interactive_p95_ms"] = float(p95i)
     return out
 
 

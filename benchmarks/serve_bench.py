@@ -33,6 +33,14 @@ Five campaigns, each printing one JSON line:
   same host/weights, plus a ServingFleet chaos pass that hard-kills a
   replica mid-storm (every request must migrate and finish exactly).
   Feeds ``SERVE_r02.json``.
+- ``disagg_storm``: the r17 disaggregated-fleet storm — interactive
+  shared-prefix traffic mixed with long-prefill batch/best_effort
+  traffic (some speculative), replayed against the r13 symmetric
+  fleet and a prefill/decode split fleet with the fleet-wide
+  GlobalBlockStore, same host/weights. Every request is checked
+  token-exact against solo fused decode; both arms then take
+  two-replica chaos kills plus a post-kill prefix probe. Feeds
+  ``SERVE_r03.json``.
 """
 
 from __future__ import annotations
@@ -817,10 +825,344 @@ def prefix_storm_campaign(preset: str, quant: str | None, tenants: int,
     }
 
 
+def disagg_storm_campaign(preset: str, quant: str | None, tenants: int,
+                          reqs_per_tenant: int, flood_threads: int,
+                          flood_reqs: int, slots: int, slot_len: int,
+                          block_size: int, shared_len: int,
+                          replicas: int, store_mb: int,
+                          long_len: int | None = None,
+                          num_blocks: int | None = None,
+                          overrides: dict | None = None) -> dict:
+    """The r17 disaggregated-serving storm: interactive shared-prefix
+    victims plus long-prefill batch/best_effort flooders (every few
+    flood requests decode speculatively), replayed against two
+    same-host fleet arms sharing one set of weights:
+
+    - ``symmetric``: the r13 fleet — N identical replicas, prefix-
+      affinity routing, per-replica prefix caches, no shared state.
+    - ``disagg``: 1 prefill replica + N-1 decode replicas. Long
+      prompts prefill on the prefill tier into block chains published
+      to the fleet-wide GlobalBlockStore; decode replicas are picked
+      by queue depth and adopt chains by hash, and hot ref-0 chains
+      promote back to the store on local eviction.
+
+    EVERY request — storm, chaos wave, and probe — is checked
+    token-exact against solo ``generate_fused`` on the same weights;
+    the throughput/latency claims are conditional on bit-identical
+    output. After the timed storm each arm takes two hard kills while
+    a chaos wave is in flight: the prefill replica (the shared-prefix
+    affinity owner on the symmetric arm) and the decode replica
+    holding the most shared-prefix blocks. Every in-flight request
+    must migrate and finish exactly. A post-kill probe (shared prefix
+    + fresh tail) then measures where the prefix went: the symmetric
+    arm buried it with the killed owner, the disagg arm re-adopts it
+    from the store."""
+    import logging
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    logging.getLogger("werkzeug").setLevel(logging.ERROR)
+
+    from kubeflow_rm_tpu.controlplane.serving_fleet import ServingFleet
+    from kubeflow_rm_tpu.controlplane.webapps.serving import ServingGateway
+    from kubeflow_rm_tpu.models import (
+        ContinuousBatchingEngine, LlamaConfig, init_params,
+    )
+    from kubeflow_rm_tpu.models.generate import generate_fused
+
+    if replicas < 3:
+        raise ValueError("disagg_storm kills two replicas mid-wave; "
+                         "--replicas must be >= 3")
+    cfg = getattr(LlamaConfig, preset)(**(overrides or {}))
+    if quant:
+        from kubeflow_rm_tpu.models.quantize import init_params_quantized
+        params = init_params_quantized(cfg, jax.random.key(0),
+                                       bits=4 if quant == "int4" else 8)
+    else:
+        params = init_params(cfg, jax.random.key(0))
+
+    if long_len is None:
+        long_len = min(2 * shared_len, slot_len - 24)
+    rng = np.random.default_rng(17)
+    vocab = cfg.vocab_size
+    shared_sys = rng.integers(1, vocab, size=shared_len).tolist()
+
+    # finite prompt pools so EVERY request has a precomputed greedy
+    # reference — exactness is asserted for the whole storm, not for
+    # one sample at the end
+    victim_pool = [shared_sys
+                   + rng.integers(1, vocab, size=4).tolist()
+                   for _ in range(8)]
+    long_pool = [rng.integers(1, vocab, size=long_len).tolist()
+                 for _ in range(8)]
+    chaos_pool = [rng.integers(1, vocab, size=shared_len + 6).tolist()
+                  for _ in range(4)]
+    probe = shared_sys + rng.integers(1, vocab, size=5).tolist()
+
+    budgets = (4, 8)
+    victim_jobs: dict[str, list] = {}
+    for t in range(tenants):
+        victim_jobs[f"tenant-{t}"] = [
+            (victim_pool[int(rng.integers(0, len(victim_pool)))],
+             int(budgets[int(rng.integers(0, len(budgets)))]), 0.02)
+            for _ in range(reqs_per_tenant)]
+    # long-prefill flood: batch/best_effort, every 4th speculative
+    flood_jobs = [
+        (long_pool[int(rng.integers(0, len(long_pool)))], 8,
+         "best_effort" if j % 2 else "batch", j % 4 == 0)
+        for j in range(flood_reqs)]
+
+    def solo(prompt, budget):
+        ref = generate_fused(params, cfg,
+                             jnp.asarray([prompt], jnp.int32),
+                             max_new_tokens=budget, max_len=slot_len)
+        return np.asarray(ref)[0, len(prompt):].tolist()
+
+    # greedy decode is prefix-stable, so one reference at the largest
+    # budget a prompt is ever asked for covers every smaller ask
+    want: dict[tuple, list] = {}
+
+    def want_for(prompt, budget):
+        key = tuple(prompt)
+        if key not in want or len(want[key]) < budget:
+            want[key] = solo(prompt, budget)
+        return want[key][:budget]
+
+    for p in victim_pool:
+        want_for(p, max(budgets))
+    for p in long_pool:
+        want_for(p, 8)
+    for p in chaos_pool:
+        want_for(p, 12)
+    want_for(probe, 8)
+
+    eng_kw: dict = dict(slots=slots, slot_len=slot_len, paged=True,
+                        block_size=block_size)
+    if num_blocks:
+        eng_kw["num_blocks"] = num_blocks
+
+    def run_arm(disagg: bool) -> dict:
+        if disagg:
+            names = (["prefill-0"]
+                     + [f"decode-{i}" for i in range(replicas - 1)])
+            roles = {n: ("prefill" if n.startswith("prefill")
+                         else "decode") for n in names}
+        else:
+            names = [f"r{i}" for i in range(replicas)]
+            roles = None
+        gws = {n: ServingGateway(
+            ContinuousBatchingEngine(params, cfg, **eng_kw),
+            max_queue=100_000, admission=False) for n in names}
+        fleet = (ServingFleet(gws, roles=roles,
+                              store_bytes=store_mb << 20)
+                 if roles else ServingFleet(gws))
+        try:
+            results: list[dict] = []
+            lock = threading.Lock()
+
+            def call(tenant, prompt, m, slo, spec=False):
+                t0 = time.perf_counter()
+                toks, _info = fleet.submit_and_wait(
+                    tenant, list(prompt), max_new_tokens=m,
+                    slo_class=slo, speculative=spec)
+                lat = (time.perf_counter() - t0) * 1e3
+                ok = toks is not None
+                with lock:
+                    results.append({
+                        "tenant": tenant, "ok": ok,
+                        "exact": ok and toks == want_for(prompt, m),
+                        "useful": m if ok else 0, "lat_ms": lat,
+                        "interactive": slo == "interactive",
+                        "speculative": spec})
+
+            # warm the compile buckets (and each arm's prefix state)
+            # before the timed region — including the speculative
+            # path, whose first compile would otherwise land inside
+            # whichever arm runs first
+            call("warm", shared_sys + [9, 9, 9, 9], 4, "interactive")
+            call("warm", long_pool[0], 4, "batch")
+            call("warm", long_pool[1], 4, "best_effort", True)
+            with lock:
+                results.clear()
+
+            def victim(name):
+                for prompt, m, gap in victim_jobs[name]:
+                    call(name, prompt, m, "interactive")
+                    time.sleep(gap)
+
+            def flooder(i):
+                for j in range(i, len(flood_jobs), flood_threads):
+                    p, m, slo, spec = flood_jobs[j]
+                    call("flood", p, m, slo, spec)
+
+            ts = ([threading.Thread(target=victim, args=(n,))
+                   for n in victim_jobs]
+                  + [threading.Thread(target=flooder, args=(i,))
+                     for i in range(flood_threads)])
+            t0 = time.perf_counter()
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join()
+            wall = time.perf_counter() - t0
+
+            def pct(v, q):
+                return round(
+                    v[min(len(v) - 1, int(q * (len(v) - 1)))], 1)
+
+            inter = sorted(r["lat_ms"] for r in results
+                           if r["interactive"] and r["ok"])
+            per_tenant_p95 = []
+            for name in victim_jobs:
+                lats = sorted(r["lat_ms"] for r in results
+                              if r["tenant"] == name and r["ok"])
+                if lats:
+                    per_tenant_p95.append(pct(lats, 0.95))
+            arm = {
+                "wall_s": round(wall, 2),
+                "ok": sum(1 for r in results if r["ok"]),
+                "failed": sum(1 for r in results if not r["ok"]),
+                "exact": sum(1 for r in results if r["exact"]),
+                "all_exact": all(r["exact"] for r in results),
+                "useful_tokens": sum(r["useful"] for r in results),
+                "useful_tok_per_s": round(
+                    sum(r["useful"] for r in results) / wall, 1),
+                "interactive_p50_ms": pct(inter, 0.50) if inter
+                else None,
+                "interactive_p95_ms": pct(inter, 0.95) if inter
+                else None,
+                "victim_p95_ms_worst": max(per_tenant_p95)
+                if per_tenant_p95 else None,
+                "speculative_requests": sum(
+                    1 for r in results if r["speculative"]),
+            }
+
+            # --- chaos: two kills while a wave is in flight ---------
+            if disagg:
+                kill_first = "prefill-0"
+                decs = [n for n in names if roles[n] == "decode"]
+                kill_second = max(
+                    decs, key=lambda n: gws[n].chain_coverage(probe))
+            else:
+                kill_first = fleet.route(list(probe))
+                rest = [n for n in names if n != kill_first]
+                kill_second = max(
+                    rest, key=lambda n: gws[n].chain_coverage(probe))
+            chaos_jobs = [chaos_pool[i % len(chaos_pool)]
+                          for i in range(2 * len(chaos_pool))]
+            chaos_res: list = [None] * len(chaos_jobs)
+
+            def go(j):
+                chaos_res[j] = fleet.submit_and_wait(
+                    "chaos", list(chaos_jobs[j]), max_new_tokens=12,
+                    slo_class="batch")
+
+            cts = [threading.Thread(target=go, args=(j,))
+                   for j in range(len(chaos_jobs))]
+            for th in cts:
+                th.start()
+            deadline = time.monotonic() + 60
+            while (not any(gws[n].engine.active_slots
+                           or gws[n].engine.queue_depth
+                           for n in names)
+                   and time.monotonic() < deadline):
+                time.sleep(0.001)
+            fleet.kill(kill_first)
+            fleet.kill(kill_second)
+            for th in cts:
+                th.join()
+            failed = sum(1 for r in chaos_res
+                         if r is None or r[0] is None)
+            exact = sum(
+                1 for j, r in enumerate(chaos_res)
+                if r is not None
+                and r[0] == want_for(chaos_jobs[j], 12))
+            arm["chaos"] = {
+                "killed": [kill_first, kill_second],
+                "requests": len(chaos_jobs),
+                "failed": failed,
+                "exact": exact,
+                "all_exact": exact == len(chaos_jobs),
+                "migrations": fleet.migrations,
+            }
+
+            # --- post-kill probe: did the shared prefix survive? ----
+            survivors = [n for n in names
+                         if n not in (kill_first, kill_second)]
+
+            def hit_tokens():
+                return sum(gws[n].engine.stats()
+                           .get("prefix_hit_tokens", 0) or 0
+                           for n in survivors)
+
+            before = hit_tokens()
+            store_hits0 = (fleet.store.stats()["hits"]
+                           if fleet.store else 0)
+            t0 = time.perf_counter()
+            ptoks, _ = fleet.submit_and_wait(
+                "probe", list(probe), max_new_tokens=8,
+                slo_class="interactive")
+            probe_ms = (time.perf_counter() - t0) * 1e3
+            arm["post_kill_probe"] = {
+                "hit_ratio": round(max(0.0, min(1.0,
+                    (hit_tokens() - before) / (len(probe) - 1))), 3),
+                "exact": ptoks == want_for(probe, 8),
+                "lat_ms": round(probe_ms, 1),
+                "store_hits_delta": (
+                    fleet.store.stats()["hits"] - store_hits0
+                    if fleet.store else 0),
+            }
+            if disagg:
+                snap = fleet.snapshot()
+                arm["handoffs"] = snap["handoffs"]
+                arm["store"] = snap["store"]
+            return arm
+        finally:
+            fleet.close()
+
+    symmetric = run_arm(False)
+    disagg = run_arm(True)
+    return {
+        "metric": "serving_disagg_storm",
+        "model": f"llama-{preset}" + (f" {quant}" if quant else " bf16")
+                 + (f" {overrides}" if overrides else ""),
+        "device": _device_tag(),
+        "workload": {
+            "victim_tenants": tenants,
+            "reqs_per_tenant": reqs_per_tenant,
+            "flood_threads": flood_threads,
+            "flood_reqs": flood_reqs,
+            "shared_prefix_len": shared_len,
+            "long_prefill_len": long_len,
+            "budgets": list(budgets),
+            "slots": slots, "slot_len": slot_len,
+            "block_size": block_size,
+            "num_blocks": num_blocks,
+            "replicas": replicas,
+            "store_mb": store_mb,
+        },
+        "arms": {"symmetric": symmetric, "disagg": disagg},
+        "disagg_wins_interactive_p95": bool(
+            disagg["interactive_p95_ms"] is not None
+            and symmetric["interactive_p95_ms"] is not None
+            and disagg["interactive_p95_ms"]
+            <= symmetric["interactive_p95_ms"]),
+        "disagg_wins_useful_tok": bool(
+            disagg["useful_tok_per_s"]
+            >= symmetric["useful_tok_per_s"]),
+        "prefix_survives_death": bool(
+            disagg["post_kill_probe"]["hit_ratio"]
+            > max(0.5, symmetric["post_kill_probe"]["hit_ratio"])),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("campaign", choices=["serve", "spec", "decode",
-                                         "storm", "prefix_storm"])
+                                         "storm", "prefix_storm",
+                                         "disagg_storm"])
     ap.add_argument("--preset", default="bench_1b")
     ap.add_argument("--quant", choices=["int8", "int4"], default=None)
     ap.add_argument("--requests", type=int, default=32)
@@ -852,7 +1194,19 @@ def main() -> int:
     ap.add_argument("--shared-len", type=int, default=88,
                     help="shared system-prompt length (prefix_storm)")
     ap.add_argument("--replicas", type=int, default=3,
-                    help="fleet size for the chaos arm (prefix_storm)")
+                    help="fleet size for the chaos arm (prefix_storm) "
+                         "/ total fleet size per arm (disagg_storm)")
+    # disagg_storm campaign knobs
+    ap.add_argument("--store-mb", type=int, default=64,
+                    help="GlobalBlockStore byte budget in MiB "
+                         "(disagg_storm)")
+    ap.add_argument("--long-len", type=int, default=None,
+                    help="long-prefill prompt length; default "
+                         "min(2*shared_len, slot_len-24) (disagg_storm)")
+    ap.add_argument("--num-blocks", type=int, default=None,
+                    help="per-engine KV pool size in blocks; small "
+                         "pools force eviction + store promotion "
+                         "(disagg_storm)")
     ap.add_argument("--out", default=None,
                     help="also write the JSON to this path")
     ap.add_argument("--jaxcheck-out", default=None,
@@ -888,6 +1242,18 @@ def main() -> int:
             args.reqs_per_tenant, args.flood_threads, args.flood_reqs,
             args.slots, args.slot_len, args.block_size,
             args.shared_len, args.replicas, overrides)
+    elif args.campaign == "disagg_storm":
+        overrides = {k: v for k, v in {
+            "dim": args.dim, "n_layers": args.layers,
+            "hidden_dim": args.hidden,
+            "max_seq_len": args.seq_len}.items() if v is not None}
+        out = disagg_storm_campaign(
+            args.preset, args.quant, args.tenants,
+            args.reqs_per_tenant, args.flood_threads, args.flood_reqs,
+            args.slots, args.slot_len, args.block_size,
+            args.shared_len, args.replicas, args.store_mb,
+            long_len=args.long_len, num_blocks=args.num_blocks,
+            overrides=overrides)
     else:
         overrides = {k: v for k, v in {
             "dim": args.dim, "n_layers": args.layers,
@@ -914,6 +1280,8 @@ def main() -> int:
             "slo_ms": args.slo_ms, "qps": args.qps,
             "slot_len": args.slot_len, "block_size": args.block_size,
             "shared_len": args.shared_len, "replicas": args.replicas,
+            "store_mb": args.store_mb, "long_len": args.long_len,
+            "num_blocks": args.num_blocks,
         },
         interleave_index=int(interleave) if interleave else None)
     print(json.dumps(out))

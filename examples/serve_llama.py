@@ -26,6 +26,20 @@ Generation length is server-fixed (``--max-new-tokens``); sampling
 params are compile-shape keys, so temperature snaps to a 0.05 grid
 and top_k snaps to a small allowed set — both documented below.
 
+Speculative decode — where it lives and what gates it:
+
+| Surface | Knob | Gate |
+|---|---|---|
+| this server | ``--speculative`` (process-wide) | solo greedy batch-1 requests only; batched/sampled requests fall back to plain fused decode |
+| engine / gateway | ``POST /generate {"speculative": true}`` per request | ``slo_class`` must be ``batch`` or ``best_effort`` (interactive keeps the paged continuous-batching path), greedy only, prompt > 3 tokens |
+| fleet front door | same per-request field, any replica | disaggregated fleets run it decode-side and skip prefix staging (the drafter needs the whole prompt locally) |
+
+All three run ``generate_speculative_fused`` (prompt-lookup n-gram
+drafting + one fused verify pass per round) and are exactness-
+preserving: output is token-for-token what plain greedy decode
+produces, never an approximation — wins show up as fewer model calls
+on repetitive continuations, worst case is one extra verify call.
+
 Tiny smoke (CPU, what tests/test_examples.py runs):
     python examples/serve_llama.py --preset tiny --selftest
 Real chip:

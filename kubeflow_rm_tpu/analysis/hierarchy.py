@@ -86,6 +86,10 @@ LOCK_HIERARCHY: dict[str, int] = {
     "jupyter.hub_registry": 430,
     "serving.fleet": 435,           # routes INTO gateways (440): uphill
     "serving.gateway": 440,
+    # the global chain store is reached from the fleet routing path
+    # AND from inside an engine step (promote-on-evict fires under the
+    # owning gateway's lock), so it must sit above both
+    "serving.store": 445,
     "metrics_service.sampler_thread": 450,  # lazy sampler-thread start
     "metrics_service.sampler": 460,         # the history ring
     # obs locks never nest with each other by design (burn rates are

@@ -20,7 +20,9 @@ import contextlib
 import copy
 import datetime
 import fnmatch
+import json
 import logging
+import os
 import threading
 import time
 from typing import Callable
@@ -345,6 +347,14 @@ class APIServer:
         # — watchers attach after replay, which emits nothing).
         self._persistence = None
         self._wal_tls = threading.local()  # _write_verb depth + ticket
+        self._wal_dir = wal_dir
+        # range tombstones: partition keys this shard has handed OFF
+        # (elastic FLIP done, donor cleanup maybe not). A respawn
+        # replaying the WAL must not resurrect the moved range — the
+        # recipient owns it now — so recovery drops tombstoned keys
+        # between populate and publish. Durable next to the WAL.
+        self._tombstones: set[str] = set()
+        self.tombstone_purged = 0
         if wal_dir:
             from kubeflow_rm_tpu.controlplane.persistence import (
                 Persistence,
@@ -352,9 +362,12 @@ class APIServer:
             self._persistence = Persistence(
                 wal_dir, fsync=wal_fsync,
                 snapshot_every=wal_snapshot_every, shard=self.shard)
+            self._tombstones = self._load_tombstones()
             rec = self._persistence.recover(CLUSTER_SCOPED_KINDS)
             for key, obj in rec.objects.items():
                 self._by_kind.setdefault(key[0], {})[key] = obj
+            if self._tombstones:
+                self._purge_tombstoned()
             for kind in self._by_kind:
                 self._publish(kind)
             self._rv = rec.rv
@@ -556,6 +569,86 @@ class APIServer:
             return False
         self._run_snapshot()
         return True
+
+    # ---- range tombstones (elastic handoff crash fencing) ------------
+
+    def _tombstone_path(self) -> str | None:
+        if not self._wal_dir:
+            return None
+        return os.path.join(self._wal_dir, "range_tombstones.json")
+
+    def _load_tombstones(self) -> set[str]:
+        path = self._tombstone_path()
+        if path is None or not os.path.exists(path):
+            return set()
+        try:
+            with open(path, encoding="utf-8") as f:
+                return {str(k) for k in json.load(f)}
+        except (OSError, ValueError):
+            # an unreadable stone file fails OPEN: worst case the shard
+            # serves moved objects until cleanup, which is the exact
+            # pre-tombstone behavior, never data loss
+            return set()
+
+    def _save_tombstones(self) -> None:
+        path = self._tombstone_path()
+        if path is None:
+            return        # no WAL: stones are in-memory only
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(sorted(self._tombstones), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _purge_tombstoned(self) -> None:
+        """Drop recovered objects whose partition key is tombstoned.
+        Runs between WAL-replay populate and snapshot publish, so the
+        moved range is never observable post-respawn. Broadcast kinds
+        replicate everywhere (no single owner to fence) and Leases are
+        shard-local by design; both are exempt."""
+        try:
+            from kubeflow_rm_tpu.controlplane.deploy.kubeclient import (
+                BROADCAST_KINDS,
+            )
+        except ImportError:
+            BROADCAST_KINDS = frozenset()
+        for kind, objs in self._by_kind.items():
+            if kind in BROADCAST_KINDS or kind == "Lease":
+                continue
+            cluster = kind in CLUSTER_SCOPED_KINDS
+            doomed = [k for k in objs
+                      if (k[2] if cluster else k[1]) in self._tombstones]
+            for k in doomed:
+                del objs[k]
+            self.tombstone_purged += len(doomed)
+
+    def set_range_tombstone(self, keys) -> list[str]:
+        """Durably mark partition keys as handed off: a respawn of
+        this shard will refuse to resurrect them from its WAL. The
+        elastic coordinator sets this on the donor right after the
+        router FLIP (the moment ownership transfers) and clears it
+        after donor cleanup deletes the moved objects for real."""
+        self._tombstones.update(str(k) for k in keys)
+        self._save_tombstones()
+        return sorted(self._tombstones)
+
+    def clear_range_tombstone(self, keys=None) -> list[str]:
+        """Lift stones — the listed partition keys, or all of them
+        when ``keys`` is None. A recipient ADOPTING a range must clear
+        any stale stone it holds for it (a range that left this shard
+        once and is now coming back), or its next respawn would purge
+        live data."""
+        if keys is None:
+            self._tombstones.clear()
+        else:
+            for k in keys:
+                self._tombstones.discard(str(k))
+        self._save_tombstones()
+        return sorted(self._tombstones)
+
+    def range_tombstones(self) -> list[str]:
+        return sorted(self._tombstones)
 
     def advance_rv_floor(self, rv: int) -> int:
         """Raise the resourceVersion counter to at least ``rv`` (no-op

@@ -292,6 +292,23 @@ class RestServer:
             out = self.api.advance_rv_floor(int(body.get("rv", 0)))
             self._send(handler, 200, {"rv": out})
             return
+        if parsed.path == "/debug/tombstone" and method == "POST":
+            # elastic handoff crash fencing: the coordinator stones a
+            # donor's moved partition keys right after the router FLIP
+            # (so a donor crash before cleanup cannot resurrect them on
+            # respawn) and lifts them after cleanup; recipients lift
+            # stale stones for ranges moving back IN before adopting
+            body = self._read_json(handler)
+            if body.get("clear_all"):
+                out = self.api.clear_range_tombstone()
+            elif "clear" in body:
+                out = self.api.clear_range_tombstone(
+                    [str(k) for k in body.get("clear") or []])
+            else:
+                out = self.api.set_range_tombstone(
+                    [str(k) for k in body.get("set") or []])
+            self._send(handler, 200, {"tombstones": out})
+            return
         if parsed.path == "/debug/snapshot" and method == "POST":
             # force a compacting snapshot NOW: the elastic-shard
             # handoff coordinator calls this on the donor before

@@ -434,6 +434,54 @@ SERVING_FLEET_REPLICAS = Gauge(
     ["state"],
     registry=REGISTRY,
 )
+SERVING_STORE_HIT_RATIO = Gauge(
+    "serving_store_hit_ratio",
+    "Fraction of GlobalBlockStore lookups that found a chain "
+    "(cumulative since boot) — the fleet-wide prefix economy's "
+    "effectiveness across replica deaths and rebalancing",
+    registry=REGISTRY,
+)
+SERVING_STORE_MISS_RATIO = Gauge(
+    "serving_store_miss_ratio",
+    "1 - serving_store_hit_ratio, set only once lookups have flowed — "
+    "the burn signal for the store-hit-collapse SLO (sustained ~1.0 "
+    "under steady traffic means the global prefix tier stopped "
+    "absorbing re-prefills)",
+    registry=REGISTRY,
+)
+SERVING_STORE_CHAINS = Gauge(
+    "serving_store_chains",
+    "Chains currently resident in the GlobalBlockStore",
+    registry=REGISTRY,
+)
+SERVING_STORE_BYTES = Gauge(
+    "serving_store_bytes",
+    "Bytes of chain payload resident in the GlobalBlockStore (LRU "
+    "evicts ref-0 chains past the byte budget)",
+    registry=REGISTRY,
+)
+SERVING_STORE_PROMOTED_TOTAL = Counter(
+    "serving_store_promoted_chains",
+    "Hot ref-0 chains promoted into the GlobalBlockStore at local "
+    "eviction time instead of dying with the replica's pool",
+    registry=REGISTRY,
+)
+SERVING_CHAIN_HANDOFF_SECONDS = Histogram(
+    "serving_chain_handoff_seconds",
+    "Prefill-tier handoff latency: route to a prefill replica, "
+    "prefill the prompt, export the chain, publish it to the global "
+    "store — the added cost a disaggregated request pays before its "
+    "decode replica installs the chain",
+    registry=REGISTRY,
+)
+SERVING_TIER_OCCUPANCY = Gauge(
+    "serving_tier_occupancy",
+    "Mean busy fraction per serving tier (prefill: active prefill "
+    "fraction of READY prefill replicas' queue+work; decode: active "
+    "slot fraction of READY decode replicas)",
+    ["tier"],
+    registry=REGISTRY,
+)
 
 # ---- observability loop: provision SLI + watchdog-visible deaths -----
 PROVISION_LATENCY_SECONDS = Histogram(

@@ -180,6 +180,17 @@ def default_slos() -> list[SLO]:
                         "block cache stopped absorbing prefill "
                         "(thrash/eviction storm, or affinity routing "
                         "gone wrong) — the paged-KV speedup is gone"),
+        GaugeSLO(
+            name="serving-store-hit-collapse",
+            metric="serving_store_miss_ratio",
+            windows=warn_only, threshold=0.95,
+            description="sustained GlobalBlockStore miss ratio >= "
+                        "0.95 while lookups flow means the fleet-wide "
+                        "prefix tier stopped absorbing re-prefills "
+                        "(byte budget too small, publish path broken, "
+                        "or traffic lost all prefix overlap) — decode "
+                        "replicas are back to paying full prefill "
+                        "after every rebalance or death"),
         RateSLO(
             name="shard-deaths", metric="shard_deaths_total",
             windows=(Window(120.0, 15.0, 1.0, "critical"),),

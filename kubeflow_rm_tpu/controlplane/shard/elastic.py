@@ -315,6 +315,22 @@ class ElasticShardManager:
                     self._post(r, "/debug/rv_floor", {"rv": st.rv})
                 except Exception:  # noqa: BLE001
                     metrics.swallowed("shard.elastic", "rv floor")
+            # a recipient may hold a stale range tombstone for a key
+            # that left it in an EARLIER handoff and is now coming
+            # back; lift it before adopting, or the recipient's next
+            # respawn would purge the live range it just received
+            incoming: dict[str, set] = {}
+            for k in moving:
+                pk = partition_key(k[0], k[2], k[1])
+                incoming.setdefault(new_ring.shard_for(pk),
+                                    set()).add(pk)
+            for r, pks in incoming.items():
+                try:
+                    self._post(r, "/debug/tombstone",
+                               {"clear": sorted(pks)})
+                except Exception:  # noqa: BLE001
+                    metrics.swallowed("shard.elastic",
+                                      "recipient stone lift")
             # donor uid -> recipient uid: recipients mint fresh uids on
             # create, so every copied ownerReference must be remapped
             # or the recipient's controllers disown the copied children
@@ -389,6 +405,22 @@ class ElasticShardManager:
             # new owners the moment the fence lifts
             urls = {m: self.runner.urls[m] for m in new_ring.members}
             router.set_topology(urls, pins=new_ring.pins)
+            # TOMBSTONE: ownership has transferred but the donor's WAL
+            # still holds the moved range. Stone it NOW — a donor that
+            # crashes before CLEANUP below would otherwise respawn
+            # with the moved objects live again (two owners, and the
+            # donor's controllers reconciling ghosts). Not earlier: a
+            # handoff that aborts pre-FLIP must leave the donor able
+            # to recover its own (still-owned) range.
+            for donor, sess in sessions.items():
+                if donor == retiring or not sess["live"]:
+                    continue
+                pks = sorted({partition_key(k[0], k[2], k[1])
+                              for k in sess["live"]})
+                try:
+                    self._post(donor, "/debug/tombstone", {"set": pks})
+                except Exception:  # noqa: BLE001
+                    metrics.swallowed("shard.elastic", "donor stone")
         finally:
             router.unfence()
         metrics.SHARD_HANDOFF_OBJECTS.labels(phase="tail").inc(tail)
@@ -403,6 +435,18 @@ class ElasticShardManager:
             if donor == retiring:
                 continue
             removed += self._cleanup_donor(donor, sess["live"])
+            # the moved objects are deleted from the donor's WAL, so
+            # its stones have done their job; lift them to keep the
+            # stone set from accreting across many rebalances
+            if sess["live"]:
+                pks = sorted({partition_key(k[0], k[2], k[1])
+                              for k in sess["live"]})
+                try:
+                    self._post(donor, "/debug/tombstone",
+                               {"clear": pks})
+                except Exception:  # noqa: BLE001
+                    metrics.swallowed("shard.elastic",
+                                      "donor stone lift")
         return {"objects_bulk": bulk, "objects_tail": tail,
                 "tail_passes": passes, "cleaned": removed}
 

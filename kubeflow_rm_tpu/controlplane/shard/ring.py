@@ -21,6 +21,15 @@ hash. Cross-shard notebook migration moves ONE namespace to a chosen
 target (not where the hash puts it); the pin makes that routing
 deterministic for every client that shares the pin map.
 
+Weights: heterogeneous members carry per-member vnode counts
+(``weights``, defaulting to ``vnodes``). A member with 2x the vnodes
+owns ~2x the keyspace — how a big shard box takes a proportionally
+bigger share. ``with_weight`` derives the re-weighted ring; because
+every member's points are independent (``hash(f"{m}#{v}")``), raising
+one member's weight only ADDS that member's points, so every moved
+key moves TO it — and lowering it only moves keys FROM it. The
+movement is minimal in the same sense as membership changes.
+
 Partition key: a namespaced object's namespace; a cluster-scoped
 object's NAME (Profile "alice" and Namespace "alice" hash identically,
 keeping a profile, its namespace, and everything inside on one shard).
@@ -42,12 +51,20 @@ def _hash(key: str) -> int:
 class HashRing:
     def __init__(self, members: list[str], *,
                  vnodes: int = DEFAULT_VNODES,
-                 pins: dict[str, str] | None = None):
+                 pins: dict[str, str] | None = None,
+                 weights: dict[str, int] | None = None):
         if not members:
             raise ValueError("HashRing needs at least one member")
         self.members = sorted(members)
         self.vnodes = vnodes
         self.pins = dict(pins or {})
+        # per-member vnode override; members absent here get `vnodes`
+        self.weights = {m: int(n) for m, n in (weights or {}).items()
+                        if m in self.members}
+        for m, n in self.weights.items():
+            if n < 1:
+                raise ValueError(
+                    f"weight for {m!r} must be >= 1, got {n}")
         for key, owner in self.pins.items():
             if owner not in self.members:
                 raise ValueError(
@@ -56,7 +73,8 @@ class HashRing:
         self._owners: list[str] = []
         pairs = sorted(
             (_hash(f"{m}#{v}"), m)
-            for m in self.members for v in range(vnodes))
+            for m in self.members
+            for v in range(self.weights.get(m, vnodes)))
         for point, owner in pairs:
             self._points.append(point)
             self._owners.append(owner)
@@ -93,7 +111,7 @@ class HashRing:
         if name in self.members:
             raise ValueError(f"{name!r} already a ring member")
         return HashRing(self.members + [name], vnodes=self.vnodes,
-                        pins=self.pins)
+                        pins=self.pins, weights=self.weights)
 
     def without_member(self, name: str,
                        drop_pins: bool = True) -> "HashRing":
@@ -108,7 +126,27 @@ class HashRing:
         pins = {k: o for k, o in self.pins.items() if o != name}
         if not drop_pins and len(pins) != len(self.pins):
             raise ValueError(f"pins still target {name!r}")
-        return HashRing(rest, vnodes=self.vnodes, pins=pins)
+        weights = {m: n for m, n in self.weights.items() if m != name}
+        return HashRing(rest, vnodes=self.vnodes, pins=pins,
+                        weights=weights)
+
+    def with_weight(self, member: str, n_vnodes: int) -> "HashRing":
+        """The ring with ``member`` carrying ``n_vnodes`` virtual
+        nodes. Ownership shifts proportionally, and every moved key
+        involves ``member`` (gains on raise, losses on lower) — other
+        members never exchange keys with each other."""
+        if member not in self.members:
+            raise ValueError(f"{member!r} not a ring member")
+        if n_vnodes < 1:
+            raise ValueError(
+                f"weight for {member!r} must be >= 1, got {n_vnodes}")
+        weights = dict(self.weights)
+        weights[member] = int(n_vnodes)
+        return HashRing(self.members, vnodes=self.vnodes,
+                        pins=self.pins, weights=weights)
+
+    def weight_of(self, member: str) -> int:
+        return self.weights.get(member, self.vnodes)
 
     def with_pin(self, key: str, member: str) -> "HashRing":
         """The ring with ``key`` explicitly owned by ``member``. A pin
@@ -118,12 +156,14 @@ class HashRing:
             raise ValueError(f"{member!r} not a ring member")
         pins = dict(self.pins)
         pins[key] = member
-        return HashRing(self.members, vnodes=self.vnodes, pins=pins)
+        return HashRing(self.members, vnodes=self.vnodes, pins=pins,
+                        weights=self.weights)
 
     def without_pin(self, key: str) -> "HashRing":
         pins = dict(self.pins)
         pins.pop(key, None)
-        return HashRing(self.members, vnodes=self.vnodes, pins=pins)
+        return HashRing(self.members, vnodes=self.vnodes, pins=pins,
+                        weights=self.weights)
 
     def moved_keys(self, new: "HashRing", keys) -> dict[str, tuple]:
         """The ownership delta driving a handoff: key ->

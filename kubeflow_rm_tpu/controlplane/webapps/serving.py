@@ -163,12 +163,17 @@ class ServingGateway:
 
     def try_submit(self, tenant: str, prompt: list[int], *,
                    max_new_tokens: int, eos_id: int | None = None,
-                   slo_class: str | None = None
+                   slo_class: str | None = None,
+                   speculative: bool = False, chain=None
                    ) -> tuple[_Pending | None, str | None]:
         """Admit or shed. Returns (pending, None) on admit,
         (None, reason) on shed — reason in
         rate|tokens|queue|slo|draining. ``slo_class`` overrides the
-        tenant policy's default engine queue."""
+        tenant policy's default engine queue. ``chain`` is an exported
+        prefix chain (from a prefill replica / the global store): the
+        engine seats it directly via ``install_chain`` and skips
+        prefill entirely. ``speculative`` routes the request through
+        the fused speculative-decode path (batch/best_effort only)."""
         pol = self._policy(tenant)
         trace = tracing.current_traceparent()
         with tracing.start_span_if_active(
@@ -208,15 +213,58 @@ class ServingGateway:
                         self._shed(tenant, "slo")
                         sp.set_attr("shed", "slo")
                         return None, "slo"
-                req = self.engine.submit(
-                    prompt, max_new_tokens=max_new_tokens,
-                    eos_id=eos_id,
-                    slo_class=slo_class or pol.slo_class)
+                if chain is not None:
+                    req = self.engine.install_chain(
+                        chain, max_new_tokens=max_new_tokens,
+                        eos_id=eos_id,
+                        slo_class=slo_class or pol.slo_class)
+                else:
+                    req = self.engine.submit(
+                        prompt, max_new_tokens=max_new_tokens,
+                        eos_id=eos_id,
+                        slo_class=slo_class or pol.slo_class,
+                        speculative=speculative)
                 pending = _Pending(req, tenant, trace=trace)
                 self._pending.append(pending)
                 cp_metrics.SERVING_QUEUE_DEPTH.set(
                     self.engine.queue_depth)
         return pending, None
+
+    # -- disaggregated-serving surface -------------------------------------
+    # A prefill replica runs ``prefill_chain`` (compute + export, no
+    # decode slot consumed); decode replicas ``adopt_chain`` (seat a
+    # store-served chain into the local pool) or install it per-request
+    # via ``try_submit(chain=...)``. All three hold the gateway lock:
+    # they touch the same engine the drain thread steps.
+
+    def prefill_chain(self, prompt: list[int]):
+        """Run prefill into cache blocks and export the serialized
+        chain (see ``models.paging.export_chain``). Returns None when
+        draining, not paged, or the pool is too full to hold it."""
+        with self._lock:
+            if self.draining:
+                return None
+            if not getattr(self.engine, "paged", False):
+                return None
+            return self.engine.prefill_chain(prompt)
+
+    def adopt_chain(self, chain) -> int:
+        """Seat an exported chain into the local block pool (no
+        request attached). Returns blocks imported (0 = already local,
+        pool full, or draining)."""
+        with self._lock:
+            if self.draining or not getattr(self.engine, "paged", False):
+                return 0
+            return self.engine.adopt_chain(chain)
+
+    def chain_coverage(self, prompt: list[int]) -> int:
+        """Tokens of ``prompt`` already covered by locally-resident
+        prefix blocks — the fleet uses this to decide whether routing
+        through the prefill tier would save anything."""
+        with self._lock:
+            if not getattr(self.engine, "paged", False):
+                return 0
+            return self.engine.chain_coverage(prompt)
 
     def wait(self, pending: _Pending, timeout_s: float = 300.0
              ) -> list[int]:
@@ -460,10 +508,14 @@ def make_serving_app(gateway: ServingGateway, cfg):
                     "interactive", "batch", "best_effort"):
                 raise BadRequest("slo_class must be one of "
                                  "interactive|batch|best_effort")
+            speculative = body.get("speculative", False)
+            if not isinstance(speculative, bool):
+                raise BadRequest("speculative must be a bool")
             try:
                 pending, reason = gateway.try_submit(
                     tenant, prompt, max_new_tokens=max_new,
-                    eos_id=eos_id, slo_class=slo_class)
+                    eos_id=eos_id, slo_class=slo_class,
+                    speculative=speculative)
             except ValueError as e:   # request cannot fit a slot
                 raise BadRequest(str(e)) from e
             if pending is None:

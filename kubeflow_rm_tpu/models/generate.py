@@ -31,6 +31,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubeflow_rm_tpu.analysis.jaxcheck import hostsync as _hostsync
 from kubeflow_rm_tpu.analysis.jaxcheck import recompile as _jit_sentinel
@@ -745,7 +746,8 @@ class EngineRequest:
     _next_id = 0
 
     def __init__(self, prompt, *, max_new_tokens, eos_id, temperature,
-                 top_k, key, slo_class="interactive"):
+                 top_k, key, slo_class="interactive",
+                 speculative=False):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -753,6 +755,12 @@ class EngineRequest:
         self.top_k = top_k
         self.key = key
         self.slo_class = slo_class
+        # per-request execution options: ``speculative`` runs the whole
+        # generation as one fused prompt-lookup program at admission
+        # (batch/best_effort only); ``chain`` is a serialized KV chain
+        # installed in place of prefill (models.paging export format)
+        self.speculative = bool(speculative)
+        self.chain = None
         self.tokens: list[int] = []
         self.done = False
         self.rid = EngineRequest._next_id
@@ -849,6 +857,13 @@ class ContinuousBatchingEngine:
         self.admitted_by_class = {c: 0 for c in SLO_CLASSES}
         self.prefix_hit_tokens = 0
         self.prompt_tokens = 0
+        # disaggregation + speculative counters
+        self.chain_installs = 0
+        self.chains_exported = 0
+        self.chains_adopted = 0
+        self.speculative_requests = 0
+        self.speculative_model_calls = 0
+        self._spec_finished: list[EngineRequest] = []
         if _jit_sentinel.enabled():
             # prompt lengths bucket to powers of two (_bucket_len), so
             # a pow-2 slot_len admits at most log2(slot_len)+1 prefill
@@ -870,13 +885,29 @@ class ContinuousBatchingEngine:
                eos_id: int | None = None, temperature: float = 0.0,
                top_k: int | None = None,
                key: jax.Array | None = None,
-               slo_class: str = "interactive") -> EngineRequest:
+               slo_class: str = "interactive",
+               speculative: bool = False) -> EngineRequest:
         Tp = len(prompt)
         if Tp == 0:
             raise ValueError("empty prompt")
         if slo_class not in SLO_CLASSES:
             raise ValueError(f"unknown slo_class {slo_class!r} "
                              f"(one of {SLO_CLASSES})")
+        if speculative:
+            # one fused program monopolizes the device for the whole
+            # generation — a latency-class request must never do that,
+            # and prompt-lookup drafting is greedy by construction
+            if slo_class == "interactive":
+                raise ValueError(
+                    "speculative decode is a batch/best_effort option "
+                    "(interactive stays on the continuous-batching "
+                    "path)")
+            if temperature > 0:
+                raise ValueError("speculative decode is greedy-only")
+            if Tp <= 3:
+                raise ValueError(
+                    f"speculative decode needs a prompt longer than "
+                    f"lookup_n=3 (got {Tp})")
         need = _bucket_len(Tp) + max_new_tokens
         if need > self.slot_len:
             raise ValueError(
@@ -893,10 +924,80 @@ class ContinuousBatchingEngine:
             raise ValueError("sampling (temperature > 0) requires a key")
         req = EngineRequest(prompt, max_new_tokens=max_new_tokens,
                             eos_id=eos_id, temperature=temperature,
-                            top_k=top_k, key=key, slo_class=slo_class)
+                            top_k=top_k, key=key, slo_class=slo_class,
+                            speculative=speculative)
         req.submitted_step = self.decode_steps
         self._queues[slo_class].append(req)
         return req
+
+    def install_chain(self, chain: dict, *, max_new_tokens: int,
+                      eos_id: int | None = None,
+                      temperature: float = 0.0,
+                      top_k: int | None = None,
+                      key: jax.Array | None = None,
+                      slo_class: str = "interactive") -> EngineRequest:
+        """Submit a request whose prefill is REPLACED by a serialized
+        KV chain (``models.paging.export_chain`` format, produced by a
+        prefill replica for exactly this prompt): the chain's chunks
+        seat directly in the pool and sampling starts from the carried
+        last-token logits — zero prefill FLOPs on this replica.
+        Verification happens here, before queueing: a corrupted chunk
+        raises ``ValueError`` and nothing is enqueued."""
+        from kubeflow_rm_tpu.models import paging
+
+        if not self.paged:
+            raise ValueError("install_chain requires the paged engine")
+        paging.verify_chain(chain)
+        if int(chain["block_size"]) != self.block_size:
+            raise ValueError(
+                f"chain block_size {chain['block_size']} != engine "
+                f"block_size {self.block_size}")
+        if chain.get("tokens") is None or chain.get("last_logits") is None:
+            raise ValueError("install_chain needs a full chain "
+                             "(tokens + last_logits); partial chains "
+                             "go through adopt_chain")
+        ck = chain["chunks_k"]
+        if (ck.shape[0] != self.cache.k.shape[0]
+                or ck.shape[2:] != self.cache.k.shape[2:]):
+            raise ValueError("chain chunk shape does not fit this "
+                             "engine's cache")
+        req = self.submit(chain["tokens"],
+                          max_new_tokens=max_new_tokens, eos_id=eos_id,
+                          temperature=temperature, top_k=top_k,
+                          key=key, slo_class=slo_class)
+        req.chain = chain
+        return req
+
+    def adopt_chain(self, chain: dict) -> int:
+        """Seat a foreign chain in the local pool as retained prefix
+        cache — no slot, no request; the next ``submit`` for a prompt
+        sharing the prefix hits it like any locally-prefilled chain.
+        Returns the number of chunks adopted (0 when the chain is
+        already local or the pool is transiently full)."""
+        from kubeflow_rm_tpu.models import paging
+
+        if not self.paged:
+            raise ValueError("adopt_chain requires the paged engine")
+        keys = list(zip(chain["covers"], chain["keys"]))
+        if len(self.pool.lookup_chain(keys)) == len(keys):
+            return 0
+        got = paging.import_chain(self.cache, self.pool, chain)
+        if got is None:
+            return 0
+        self.cache, blocks = got
+        self.pool.decref(blocks)   # retained at ref 0 until evicted
+        self.chains_adopted += 1
+        return len(blocks)
+
+    def chain_coverage(self, prompt) -> int:
+        """Prompt tokens the local prefix cache already covers."""
+        from kubeflow_rm_tpu.models import paging
+
+        if not self.paged or not self.prefix_cache:
+            return 0
+        keys = paging.prefix_keys(prompt, self.block_size)
+        chain = self.pool.lookup_chain(keys)
+        return keys[len(chain) - 1][0] if chain else 0
 
     def _next_queued(self) -> EngineRequest | None:
         """Smooth weighted round-robin over the non-empty class
@@ -929,13 +1030,32 @@ class ContinuousBatchingEngine:
         return out
 
     def _admit(self) -> None:
+        from kubeflow_rm_tpu.models import paging
+
         for i in range(self.slots):
             if self._slot_req[i] is not None:
                 continue
-            req = self._next_queued()
-            if req is None:
-                return
-            if self.paged:
+            while True:
+                req = self._next_queued()
+                if req is None:
+                    return
+                if req.speculative:
+                    # runs whole at this boundary, never holds a slot
+                    self._run_speculative(req)
+                    continue
+                break
+            if self.paged and req.chain is not None:
+                keys = paging.prefix_keys(req.prompt, self.block_size)
+                if len(self.pool.lookup_chain(keys)) == len(keys):
+                    # full local hit: adopt the cached blocks instead
+                    # of seating duplicate chunks from the payload
+                    req.chain = None
+            if self.paged and req.chain is not None:
+                last = self._admit_chain(i, req)
+                if last is None:
+                    self._requeue_front(req)
+                    return
+            elif self.paged:
                 last = self._admit_paged(i, req)
                 if last is None:
                     # transient block OOM: head waits at the front of
@@ -944,14 +1064,41 @@ class ContinuousBatchingEngine:
                     # always makes progress eventually
                     self._requeue_front(req)
                     return
+                self.prefills += 1
             else:
                 last = self._admit_contiguous(i, req)
+                self.prefills += 1
             self._last[i] = last
             self._slot_req[i] = req
             req.admitted_step = self.decode_steps
-            self.prefills += 1
             self.admitted_total += 1
             self.admitted_by_class[req.slo_class] += 1
+
+    def _run_speculative(self, req: EngineRequest) -> None:
+        """Execute a speculative request whole: one fused prompt-lookup
+        program (``generate_speculative_fused``), greedy, exactness-
+        matched to ``generate_fused`` for the same prompt. The request
+        finishes at this token boundary without consuming a slot."""
+        stats: dict = {}
+        out = generate_speculative_fused(
+            self.params, self.cfg,
+            jnp.asarray([req.prompt], jnp.int32),
+            max_new_tokens=req.max_new_tokens, eos_id=req.eos_id,
+            stats=stats)
+        toks = [int(t) for t in
+                jax.device_get(out)[0][len(req.prompt):]]
+        if req.eos_id is not None and req.eos_id in toks:
+            toks = toks[:toks.index(req.eos_id) + 1]
+        req.tokens = toks
+        req.done = True
+        req.admitted_step = self.decode_steps
+        req.finished_step = self.decode_steps
+        self.admitted_total += 1
+        self.admitted_by_class[req.slo_class] += 1
+        self.finished_total += 1
+        self.speculative_requests += 1
+        self.speculative_model_calls += stats.get("model_calls", 0)
+        self._spec_finished.append(req)
 
     def _admit_contiguous(self, i: int, req: EngineRequest):
         Tp = len(req.prompt)
@@ -1046,12 +1193,171 @@ class ContinuousBatchingEngine:
         if fork:
             pool.decref([chain[shared_full]])   # unpin the fork source
         if self.prefix_cache:
+            parent = None
             for covered, key in keys:
-                pool.register(key, final_row[(covered - 1) // BS])
+                pool.register(key, final_row[(covered - 1) // BS],
+                              parent=parent, covered=covered)
+                parent = key
         self._slot_blocks[i] = shared + fresh
         self.prefix_hit_tokens += n_hit
         self.prompt_tokens += Tp
         return last
+
+    def _admit_chain(self, i: int, req: EngineRequest):
+        """Seat a verified foreign chain straight into slot ``i``: the
+        chain's chunks land in freshly allocated blocks, counters seat
+        at the real prompt length, and sampling starts from the
+        carried last-token logits — the decode replica runs ZERO
+        prefill FLOPs. Returns ``None`` on transient block OOM.
+
+        Exactness: chunk contents are the prefill replica's
+        ``paged_prefill`` output for this exact prompt on the same
+        weights, round-tripped through host memory bit-for-bit;
+        columns past the prompt carry ``_UNFILLED`` positions so the
+        causal mask hides them, and decode overwrites from offset Tp
+        exactly as a local admission would."""
+        from kubeflow_rm_tpu.models import paging
+
+        pool, BS = self.pool, self.block_size
+        maxb = self.slot_len // BS
+        chain = req.chain
+        Tp, budget = len(req.prompt), req.max_new_tokens
+        nchain = len(chain["keys"])
+        needed = -(-(Tp + budget) // BS)
+        fresh = pool.alloc(needed)
+        if fresh is None:
+            return None
+        cache = self.cache
+        idx = jnp.asarray(fresh[:nchain], jnp.int32)
+        final_row = [paging.NULL_BLOCK] * maxb
+        final_row[:needed] = fresh
+        positions = cache.positions.at[idx].set(
+            jnp.asarray(chain["chunks_pos"], jnp.int32))
+        if needed > nchain:
+            # decode-budget blocks past the chain may be recycled:
+            # wipe their positions so the gathered strip never shows a
+            # stale row (the no-stale-reads guarantee paged_install
+            # provides on the prefill path)
+            tail = jnp.asarray(fresh[nchain:], jnp.int32)
+            positions = positions.at[tail].set(_UNFILLED)
+        self.cache = paging.PagedKVCache(
+            k=cache.k.at[:, idx].set(
+                jnp.asarray(chain["chunks_k"], cache.k.dtype)),
+            v=cache.v.at[:, idx].set(
+                jnp.asarray(chain["chunks_v"], cache.v.dtype)),
+            positions=positions,
+            block_tables=cache.block_tables.at[i].set(
+                jnp.asarray(final_row, jnp.int32)),
+            write_idx=cache.write_idx.at[i].set(Tp),
+            pos_next=cache.pos_next.at[i].set(Tp),
+        )
+        if self.prefix_cache:
+            parent = None
+            for j, key in enumerate(chain["keys"]):
+                pool.register(key, fresh[j], parent=parent,
+                              covered=chain["covers"][j])
+                parent = key
+        self._slot_blocks[i] = fresh
+        self.prefix_hit_tokens += Tp   # the whole prompt arrived cached
+        self.prompt_tokens += Tp
+        self.chain_installs += 1
+        return jnp.asarray(chain["last_logits"])
+
+    def prefill_chain(self, prompt) -> dict | None:
+        """Prefill-replica entry point: compute the full prompt's KV
+        chain into the local pool (adopting any cached prefix),
+        register it, and export it serialized with the last real
+        token's logits — so a decode replica can ``install_chain`` it
+        without prefilling. No decode slot is touched; the chain stays
+        behind as retained (ref-0) prefix cache, so a resumed or
+        repeated prompt only prefills its new suffix. Returns ``None``
+        on transient block OOM."""
+        from kubeflow_rm_tpu.models import paging
+
+        if not self.paged:
+            raise ValueError("prefill_chain requires the paged engine")
+        prompt = [int(t) for t in prompt]
+        Tp = len(prompt)
+        if Tp == 0:
+            raise ValueError("empty prompt")
+        if _bucket_len(Tp) > self.slot_len:
+            raise ValueError(
+                f"prompt bucket {_bucket_len(Tp)} > slot_len "
+                f"{self.slot_len}")
+        pool, BS = self.pool, self.block_size
+        maxb = self.slot_len // BS
+        keys = paging.prefix_keys(prompt, BS)
+        chain = pool.lookup_chain(keys)
+        n_hit = min(keys[len(chain) - 1][0] if chain else 0, Tp - 1)
+        while n_hit > 0 and n_hit + _bucket_len(Tp - n_hit) > self.slot_len:
+            n_hit = ((n_hit - 1) // BS) * BS
+        shared_full = n_hit // BS
+        fork = n_hit % BS != 0
+        shared = chain[:shared_full]
+        needed = -(-Tp // BS)          # prompt only: no decode budget
+        owned_n = needed - shared_full
+        pins = chain[:shared_full + 1] if fork else shared
+        pool.incref(pins)
+        fresh = pool.alloc(owned_n)
+        if fresh is None:
+            pool.decref(pins)
+            return None
+        if fork:
+            pool.cow_forks += 1
+        load_row = [paging.NULL_BLOCK] * maxb
+        load_row[:len(pins)] = pins
+        final_row = [paging.NULL_BLOCK] * maxb
+        final_row[:shared_full] = shared
+        final_row[shared_full:needed] = fresh
+        suffix = prompt[n_hit:]
+        Tc = _bucket_len(len(suffix))
+        padded = jnp.asarray([suffix + [0] * (Tc - len(suffix))],
+                             jnp.int32)
+        _jit_sentinel.note("engine.prefill", padded)
+        with _hostsync.region("engine.prefill"):
+            last, tk, tv, tpos = paging.paged_prefill(
+                self.params, self.cfg, self.cache,
+                jnp.asarray(load_row, jnp.int32),
+                jnp.asarray(n_hit, jnp.int32), padded,
+                jnp.asarray(len(suffix), jnp.int32))
+        # carve owned chunks into their blocks WITHOUT seating any
+        # slot table — prefill replicas never decode, the chain lives
+        # purely in the pool + prefix index
+        L = self.cache.k.shape[0]
+        ck = tk[:, 0].reshape(L, maxb, BS, *tk.shape[3:])
+        cv = tv[:, 0].reshape(L, maxb, BS, *tv.shape[3:])
+        cp = tpos[0].reshape(maxb, BS)
+        idx = jnp.asarray(fresh, jnp.int32)
+        self.cache = paging.PagedKVCache(
+            k=self.cache.k.at[:, idx].set(ck[:, shared_full:needed]),
+            v=self.cache.v.at[:, idx].set(cv[:, shared_full:needed]),
+            positions=self.cache.positions.at[idx].set(
+                cp[shared_full:needed]),
+            block_tables=self.cache.block_tables,
+            write_idx=self.cache.write_idx,
+            pos_next=self.cache.pos_next,
+        )
+        if fork:
+            pool.decref([chain[shared_full]])
+        parent = None
+        for covered, key in keys:
+            pool.register(key, final_row[(covered - 1) // BS],
+                          parent=parent, covered=covered)
+            parent = key
+        out = paging.export_chain(self.cache, pool, prompt)
+        # logits keep their compute dtype: install-side sampling must
+        # see the exact values solo prefill would produce
+        out["last_logits"] = np.array(last)
+        out["nbytes"] += out["last_logits"].nbytes
+        # release: everything drops to ref 0 — registered blocks are
+        # retained as prefix cache until evicted (or promoted)
+        pool.decref(shared)
+        pool.decref(fresh)
+        self.prefills += 1
+        self.chains_exported += 1
+        self.prefix_hit_tokens += n_hit
+        self.prompt_tokens += Tp
+        return out
 
     def _retire(self, i: int) -> None:
         if self.paged and self._slot_blocks[i] is not None:
@@ -1065,6 +1371,10 @@ class ContinuousBatchingEngine:
         the requests that finished at this boundary."""
         self._admit()
         finished: list[EngineRequest] = []
+        if self._spec_finished:
+            # speculative requests ran whole inside _admit
+            finished.extend(self._spec_finished)
+            self._spec_finished = []
         tokens = [0] * self.slots
         active = [False] * self.slots
         for i, req in enumerate(self._slot_req):
@@ -1153,6 +1463,8 @@ class ContinuousBatchingEngine:
             "finished_total": self.finished_total,
             "batch_occupancy": (self.occupancy_sum / (steps * self.slots)
                                 if steps else 0.0),
+            "speculative_requests": self.speculative_requests,
+            "speculative_model_calls": self.speculative_model_calls,
         }
         if self.paged:
             out.update(self.pool.stats())
@@ -1161,4 +1473,7 @@ class ContinuousBatchingEngine:
             out["prefix_hit_ratio"] = (
                 self.prefix_hit_tokens / self.prompt_tokens
                 if self.prompt_tokens else 0.0)
+            out["chain_installs"] = self.chain_installs
+            out["chains_exported"] = self.chains_exported
+            out["chains_adopted"] = self.chains_adopted
         return out

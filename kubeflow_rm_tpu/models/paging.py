@@ -312,9 +312,20 @@ class BlockPool:
         self._ref: dict[int, int] = {}
         self._index: OrderedDict[bytes, int] = OrderedDict()
         self._block_key: dict[int, bytes] = {}
+        # chain linkage for registered keys: key -> predecessor key
+        # (None at the chain head) and key -> covered-token count.
+        # Export and promote-on-evict walk these to rebuild the chain
+        # a key belongs to without re-hashing the prompt.
+        self._parent: dict[bytes, bytes | None] = {}
+        self._covered: dict[bytes, int] = {}
+        #: optional ``fn(key, block)`` called just before a retained
+        #: ref-0 prefix block is evicted, while its content is still
+        #: in the device pool — the promote-to-global-store hook.
+        self.on_evict = None
         self.cow_forks = 0
         self.evictions = 0
         self.alloc_failures = 0
+        self.evict_hook_errors = 0
 
     # -- capacity ------------------------------------------------------
 
@@ -359,8 +370,21 @@ class BlockPool:
     def _evict_one(self) -> int:
         for key, b in self._index.items():     # oldest entry first
             if self._ref.get(b, 0) == 0:
+                if self.on_evict is not None:
+                    # promotion reads the block from the device pool,
+                    # so it must run BEFORE the id is handed out for
+                    # reuse; a failing hook must never break alloc
+                    try:
+                        self.on_evict(key, b)
+                    except Exception:  # kfrm: disable=KFRM005
+                        # counted locally (evict_hook_errors): the
+                        # models layer can't import controlplane
+                        # metrics, and alloc must survive any hook
+                        self.evict_hook_errors += 1
                 del self._index[key]
                 del self._block_key[b]
+                self._parent.pop(key, None)
+                self._covered.pop(key, None)
                 self.evictions += 1
                 return b
         raise RuntimeError("evict with no evictable block "
@@ -390,10 +414,17 @@ class BlockPool:
             self._index.move_to_end(key)
         return b
 
-    def register(self, key: bytes, block: int) -> int:
+    def register(self, key: bytes, block: int, *,
+                 parent: bytes | None = None,
+                 covered: int | None = None) -> int:
         """Publish ``block`` under ``key``; first writer wins (an
         identical prefix prefilled twice registers once — the second
-        block simply frees on retire)."""
+        block simply frees on retire). ``parent``/``covered`` record
+        the chain linkage used by export and promote-on-evict."""
+        if parent is not None or key not in self._parent:
+            self._parent[key] = parent
+        if covered is not None:
+            self._covered[key] = int(covered)
         existing = self._index.get(key)
         if existing is not None:
             self._index.move_to_end(key)
@@ -403,6 +434,12 @@ class BlockPool:
         self._index[key] = block
         self._block_key[block] = key
         return block
+
+    def parent_of(self, key: bytes) -> bytes | None:
+        return self._parent.get(key)
+
+    def covered_of(self, key: bytes) -> int | None:
+        return self._covered.get(key)
 
     def lookup_chain(self, keys) -> list[int]:
         """Longest CONSECUTIVE run of ``keys`` present in the index
@@ -429,3 +466,151 @@ class BlockPool:
             "evictions": self.evictions,
             "alloc_failures": self.alloc_failures,
         }
+
+
+# ---------------------------------------------------------------------------
+# chain export / import — replica-to-replica block transfer
+# ---------------------------------------------------------------------------
+# The chained ``prefix_keys`` hashes commit to the whole prefix, so a
+# chain is a replica-agnostic name for its K/V content: any pool that
+# prefilled the same tokens on the same weights holds bit-identical
+# blocks under the same keys. A serialized chain carries the host
+# copies of those blocks plus per-chunk checksums; ``import_chain``
+# refuses a corrupted chunk without touching pool state, and a chain
+# adopted into a foreign pool decodes bit-identically to solo
+# ``generate_fused`` (tests/test_chain_transfer.py).
+
+
+def _chunk_checksum(ck: np.ndarray, cv: np.ndarray,
+                    cp: np.ndarray) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.ascontiguousarray(ck).tobytes())
+    h.update(np.ascontiguousarray(cv).tobytes())
+    h.update(np.ascontiguousarray(cp).tobytes())
+    return h.digest()
+
+
+def export_block_chunk(cache: PagedKVCache, block: int,
+                       valid: int) -> dict:
+    """Host copy of ONE pool block, sanitized past ``valid`` tokens
+    (zero K/V, ``_UNFILLED`` positions) so the bytes — and therefore
+    the checksum — depend only on the prefix the block's key names,
+    never on whatever a later request generated into the tail."""
+    ck = np.array(cache.k[:, block])           # (L, BS, KVH, hd)
+    cv = np.array(cache.v[:, block])
+    cp = np.array(cache.positions[block], np.int32)
+    ck[:, valid:] = 0
+    cv[:, valid:] = 0
+    cp[valid:] = _UNFILLED
+    return {"k": ck, "v": cv, "pos": cp,
+            "sum": _chunk_checksum(ck, cv, cp)}
+
+
+def export_chain(cache: PagedKVCache, pool: BlockPool,
+                 tokens) -> dict | None:
+    """Serialize the pool's chain for ``tokens`` — every chunk's K/V,
+    positions, keys, and checksums — or ``None`` if the pool does not
+    hold the full chain. Tail columns past each chunk's covered count
+    are sanitized, so identical prompts export identical bytes."""
+    tokens = [int(t) for t in tokens]
+    keys = prefix_keys(tokens, pool.block_size)
+    blocks = pool.lookup_chain(keys)
+    if len(blocks) < len(keys):
+        return None
+    BS = pool.block_size
+    idx = jnp.asarray(blocks, jnp.int32)
+    ck = np.array(cache.k[:, idx])             # (L, NC, BS, KVH, hd)
+    cv = np.array(cache.v[:, idx])
+    cp = np.array(cache.positions[idx], np.int32)
+    for i, (covered, _key) in enumerate(keys):
+        valid = covered - i * BS
+        ck[:, i, valid:] = 0
+        cv[:, i, valid:] = 0
+        cp[i, valid:] = _UNFILLED
+    sums = [_chunk_checksum(ck[:, i], cv[:, i], cp[i])
+            for i in range(len(keys))]
+    return {
+        "version": 1,
+        "block_size": BS,
+        "tokens": tokens,
+        "covered": keys[-1][0],
+        "keys": [k for _c, k in keys],
+        "covers": [c for c, _k in keys],
+        "chunks_k": ck,
+        "chunks_v": cv,
+        "chunks_pos": cp,
+        "sums": sums,
+        "nbytes": int(ck.nbytes + cv.nbytes + cp.nbytes),
+    }
+
+
+def verify_chain(chain: dict) -> None:
+    """Raise ``ValueError`` unless the chain is internally consistent:
+    chunk checksums match the payload, and — when the prompt rides
+    along — the keys really are the chained hashes of the tokens.
+    Checks mutate nothing, so a refusal leaves any pool untouched."""
+    keys = list(chain.get("keys") or [])
+    covers = list(chain.get("covers") or [])
+    sums = list(chain.get("sums") or [])
+    nc = len(keys)
+    if not nc or len(covers) != nc or len(sums) != nc:
+        raise ValueError("chain integrity: malformed key/cover/sum "
+                         "lists")
+    ck, cv, cp = (chain["chunks_k"], chain["chunks_v"],
+                  chain["chunks_pos"])
+    BS = int(chain["block_size"])
+    if (ck.shape[1] != nc or cv.shape != ck.shape
+            or cp.shape != (nc, BS) or ck.shape[2] != BS):
+        raise ValueError("chain integrity: chunk shapes disagree "
+                         "with the key list")
+    tokens = chain.get("tokens")
+    if tokens is not None:
+        want = prefix_keys(tokens, BS)
+        if ([k for _c, k in want] != keys
+                or [c for c, _k in want] != covers):
+            raise ValueError("chain integrity: keys are not the "
+                             "chained hashes of the tokens")
+    for i in range(nc):
+        if _chunk_checksum(ck[:, i], cv[:, i], cp[i]) != sums[i]:
+            raise ValueError(
+                f"chain integrity: chunk {i} checksum mismatch")
+
+
+def import_chain(cache: PagedKVCache, pool: BlockPool,
+                 chain: dict) -> tuple[PagedKVCache, list[int]] | None:
+    """Adopt a foreign chain: verify it, seat its chunks in freshly
+    allocated blocks, and register every key. Returns the new cache
+    plus the allocated blocks (ref 1 — the caller decrefs them to
+    hand the chain to the LRU as retained prefix cache), or ``None``
+    on clean OOM. Keys already registered locally keep their existing
+    blocks; the redundant fresh block simply frees on decref."""
+    verify_chain(chain)
+    if int(chain["block_size"]) != pool.block_size:
+        raise ValueError(
+            f"chain block_size {chain['block_size']} != pool "
+            f"block_size {pool.block_size}")
+    nc = len(chain["keys"])
+    if chain["chunks_k"].shape[0] != cache.k.shape[0] \
+            or chain["chunks_k"].shape[2:] != cache.k.shape[2:]:
+        raise ValueError("chain chunk shape does not fit this cache")
+    blocks = pool.alloc(nc)
+    if blocks is None:
+        return None
+    idx = jnp.asarray(blocks, jnp.int32)
+    cache = PagedKVCache(
+        k=cache.k.at[:, idx].set(
+            jnp.asarray(chain["chunks_k"], cache.k.dtype)),
+        v=cache.v.at[:, idx].set(
+            jnp.asarray(chain["chunks_v"], cache.v.dtype)),
+        positions=cache.positions.at[idx].set(
+            jnp.asarray(chain["chunks_pos"], jnp.int32)),
+        block_tables=cache.block_tables,
+        write_idx=cache.write_idx,
+        pos_next=cache.pos_next,
+    )
+    parent = None
+    for i, key in enumerate(chain["keys"]):
+        pool.register(key, blocks[i], parent=parent,
+                      covered=chain["covers"][i])
+        parent = key
+    return cache, blocks

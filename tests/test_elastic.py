@@ -254,6 +254,73 @@ def test_migrate_namespace_pins_and_moves(fleet):
 
 def test_partition_key_mirrors_router_rule():
     assert partition_key("Pod", "p", "ns1") == "ns1"
+
+
+# ---- range tombstones (donor-crash fencing) --------------------------
+
+def test_donor_crash_after_flip_cannot_resurrect_moved_range(
+        fleet, monkeypatch):
+    """The FLIP..CLEANUP crash window: ownership has transferred but
+    the donor's WAL still holds the moved range. A donor that dies
+    there and respawns from its WAL must NOT bring the moved objects
+    back to life (two owners, ghost reconciles) — the range tombstone
+    set at FLIP purges them during recovery."""
+    router = ShardedKubeAPIServer(fleet.urls, retry_window_s=10.0)
+    elastic = ElasticShardManager(fleet, router)
+    spaces = _seed(router)
+    old_ring = HashRing(["shard-0", "shard-1"])
+
+    # crash the coordinator at CLEANUP: FLIP (and the tombstone write)
+    # already happened, donor copies of the moved range remain
+    def crash(self, donor, live):
+        raise RuntimeError("donor unreachable during cleanup")
+    monkeypatch.setattr(ElasticShardManager, "_cleanup_donor", crash)
+    with pytest.raises(RuntimeError):
+        elastic.split()
+    monkeypatch.undo()
+
+    new = next(m for m in router.ring.members
+               if m not in old_ring.members)
+    moved = {ns: old_ring.shard_for(ns) for ns in spaces
+             if router.shard_of("Pod", None, ns) == new}
+    assert moved  # the split did take a slice
+    donors = sorted(set(moved.values()))
+    for donor in donors:
+        # the stone is durably set (cleanup never ran to lift it) ...
+        assert fleet.apis[donor].range_tombstones()
+        # ... and survives a SIGKILL + WAL respawn: recovery purges
+        # the moved range instead of resurrecting it
+        fleet.kill(donor)
+        assert fleet.apis[donor].tombstone_purged > 0
+    for ns, donor in moved.items():
+        for j in range(3):
+            assert fleet.apis[donor].try_get("Pod", f"p-{j}", ns) \
+                is None, (ns, donor)
+    # zero loss overall: everything reads back from its ring owner only
+    _audit(router, fleet, spaces)
+
+
+def test_handoff_into_tombstoned_range_lifts_the_stone(fleet):
+    """A range that once left a shard can come BACK (pinned
+    migration, weight change). The recipient must lift its stale stone
+    before adopting, or its next respawn would purge live data."""
+    router = ShardedKubeAPIServer(fleet.urls, retry_window_s=10.0)
+    elastic = ElasticShardManager(fleet, router)
+    ns = "boomerang"
+    router.ensure_namespace(ns)
+    router.create(_pod("p-0", ns))
+    home = router.shard_of("Pod", None, ns)
+    target = next(m for m in router.ring.members if m != home)
+    # stale stone, as if ns left `target` in an earlier rebalance
+    # whose cleanup crashed before lifting it
+    fleet.apis[target].set_range_tombstone([ns])
+
+    assert elastic.migrate_namespace(ns, target) is True
+    assert ns not in fleet.apis[target].range_tombstones()
+    # the adopted range survives the recipient's own respawn
+    fleet.kill(target)
+    assert fleet.apis[target].try_get("Pod", "p-0", ns) is not None
+    assert router.get("Pod", "p-0", ns) is not None
     assert partition_key("Profile", "alice", None) == "alice"
     assert partition_key("Namespace", "alice", None) == "alice"
 

@@ -120,6 +120,74 @@ def test_ring_pins_override_hash_and_die_with_their_target():
         ring.with_pin(key, "not-a-member")
 
 
+def test_ring_weights_shift_ownership_proportionally():
+    """A member with k-times the vnodes owns roughly k-times the
+    keyspace — the heterogeneous-shard knob. Proportionality is the
+    contract, vnode noise the tolerance."""
+    keys = [f"ns-{i}" for i in range(4000)]
+    flat = HashRing(["s0", "s1", "s2"], vnodes=64)
+    heavy = flat.with_weight("s0", 192)  # 3x the default 64
+    assert heavy.weight_of("s0") == 192
+    assert heavy.weight_of("s1") == 64
+    counts = {m: len(ks) for m, ks in heavy.spread(keys).items()}
+    # fair shares: s0 gets 192/320, the others 64/320 each
+    assert counts["s0"] / len(keys) == pytest.approx(192 / 320,
+                                                     abs=0.08)
+    assert counts["s1"] / len(keys) == pytest.approx(64 / 320,
+                                                     abs=0.08)
+    # deterministic: a fresh construction with the same weights routes
+    # identically to the derived ring
+    fresh = HashRing(["s0", "s1", "s2"], vnodes=64,
+                     weights={"s0": 192})
+    for k in keys[:500]:
+        assert heavy.shard_for(k) == fresh.shard_for(k)
+
+
+def test_ring_with_weight_moves_only_the_reweighted_members_keys():
+    """Minimality: raising s0's weight only adds s0's points, so every
+    moved key moves TO s0; lowering it back moves the same keys FROM
+    s0. Bystanders never exchange keys with each other."""
+    keys = [f"ns-{i}" for i in range(2000)]
+    base = HashRing(["s0", "s1", "s2"], vnodes=64)
+    up = base.with_weight("s0", 128)
+    moved = base.moved_keys(up, keys)
+    assert moved  # the heavier member claims a non-empty slice
+    for key, (old, new) in moved.items():
+        assert new == "s0" and old != "s0"
+    # and the delta is bounded by the share increase (~1/5 of the
+    # keyspace here), not a reshuffle
+    assert len(moved) < 0.4 * len(keys)
+    # the inverse derivation returns every key to its old owner
+    down = up.with_weight("s0", 64)
+    for k in keys:
+        assert down.shard_for(k) == base.shard_for(k)
+    back = up.moved_keys(down, keys)
+    for key, (old, new) in back.items():
+        assert old == "s0" and new != "s0"
+
+
+def test_ring_weights_survive_derivations_and_validate():
+    ring = HashRing(["s0", "s1"], vnodes=32).with_weight("s0", 96)
+    # weights thread through every derivation constructor
+    grown = ring.with_member("s2")
+    assert grown.weight_of("s0") == 96 and grown.weight_of("s2") == 32
+    shrunk = grown.without_member("s0")
+    assert "s0" not in shrunk.weights  # the retiree's weight dies too
+    key = "ns-w"
+    pinned = ring.with_pin(key, "s1")
+    assert pinned.weight_of("s0") == 96
+    assert pinned.without_pin(key).weight_of("s0") == 96
+    with pytest.raises(ValueError):
+        ring.with_weight("nope", 8)
+    with pytest.raises(ValueError):
+        ring.with_weight("s0", 0)
+    with pytest.raises(ValueError):
+        HashRing(["s0"], weights={"s0": -1})
+    # derivation is immutable: the source ring is untouched
+    assert HashRing(["s0", "s1"], vnodes=32).weights == {}
+    assert ring.weight_of("s0") == 96
+
+
 # ---- router over an in-thread 2-shard stack --------------------------
 
 class _Stack:

@@ -28,6 +28,14 @@ Deliberate syncs are fine outside regions (a metrics fetch at a log
 boundary); witnesses are only recorded while a region is open on the
 calling thread, so instrumenting a loop costs nothing in reports
 unless the loop actually syncs.
+
+The host-offload train step (r18) moved a *deliberate* device→host
+stream inside the ``train.step`` hot region — the transfers ARE the
+feature there, not a bug. :func:`sanctioned` is the escape hatch: a
+nested context naming the site (``train.offload_stream``) under which
+syncs are tallied per-site (:func:`sanctioned_counts`) instead of
+witnessed. An unsanctioned sync inside the same region still trips
+(tests/test_jaxcheck.py pins this), so the probe keeps its teeth.
 """
 
 from __future__ import annotations
@@ -48,6 +56,7 @@ _STACK_LIMIT = 12
 # (same exemption lockgraph.py itself takes).
 _lock = threading.Lock()  # kfrm: disable=KFRM001
 _witnesses: list[dict] = []
+_sanctioned_counts: dict[tuple[str, str], int] = {}  # (site, kind) -> n
 _installed = False
 _originals: list[tuple] = []   # (owner, attr, original) for uninstall
 _tls = threading.local()
@@ -79,6 +88,13 @@ def _regions() -> list:
     return stack
 
 
+def _sanctioned_stack() -> list:
+    stack = getattr(_tls, "sanctioned", None)
+    if stack is None:
+        stack = _tls.sanctioned = []
+    return stack
+
+
 # nullcontext is reusable AND reentrant, so one shared instance
 # serves every disabled region() call forever — zero allocation on
 # the production path
@@ -104,9 +120,36 @@ def region(name: str):
     return _cm()
 
 
+def sanctioned(site: str):
+    """Declare a deliberate-sync site: syncs on this thread while the
+    context is open are counted under ``site`` instead of witnessed —
+    the escape hatch for transfers that ARE the feature (the offload
+    step's ``train.offload_stream``). Null and free when the probe is
+    disabled; syncs outside the context (even inside the same hot
+    region) still trip as witnesses."""
+    if not _enabled:
+        return _NULL
+
+    @contextlib.contextmanager
+    def _cm():
+        _sanctioned_stack().append(site)
+        try:
+            yield
+        finally:
+            _sanctioned_stack().pop()
+
+    return _cm()
+
+
 def _record(kind: str) -> None:
     stack = _regions()
     if not stack:
+        return
+    sanction = _sanctioned_stack()
+    if sanction:
+        with _lock:
+            k = (sanction[-1], kind)
+            _sanctioned_counts[k] = _sanctioned_counts.get(k, 0) + 1
         return
     frames = traceback.format_list(
         traceback.extract_stack(limit=_STACK_LIMIT)[:-2])
@@ -187,7 +230,17 @@ def witnesses() -> list[dict]:
         return list(_witnesses)
 
 
+def sanctioned_counts() -> dict:
+    """``{(site, kind): count}`` for syncs under :func:`sanctioned` —
+    the observability half of the escape hatch (the offload stream's
+    transfer count shows up here, not in :func:`witnesses`)."""
+    with _lock:
+        return dict(_sanctioned_counts)
+
+
 def reset() -> None:
-    """Drop recorded witnesses (the patch, if installed, remains)."""
+    """Drop recorded witnesses and sanctioned-site tallies (the patch,
+    if installed, remains)."""
     with _lock:
         _witnesses.clear()
+        _sanctioned_counts.clear()

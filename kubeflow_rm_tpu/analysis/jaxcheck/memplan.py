@@ -20,13 +20,24 @@ runs, shapes only, nothing materializes) and walks it with
   bench.py's "~7 G bf16 state", optim.py's 4-bytes/param adafactor
   rule) an anchor with the predicted-vs-measured delta.
 
-The **extrapolation** rows de-risk ROADMAP item 1 before
+The **extrapolation** rows de-risked ROADMAP item 1 before
 ``training/loop.py`` changes: a 2.7B rung with the optimizer update
 streamed through host RAM (on-chip peak = grad phase + accumulation
 buffer + a double-buffered stream slot — predicted to FIT the chip
 that measurably OOMs today), the same treatment at 7B (predicted
 still-OOM: params+grads alone exceed the chip, so offload must pair
 with sharding), and the 7B north star on a v5p-8 fsdp mesh.
+
+Since r18 the offload arm is **modeled natively, not just
+extrapolated**: ``training.train.make_train_step(offload="optimizer")``
+exists, and :func:`offload_native_rows` walks its REAL device program
+(the jitted grad phase the streamed step actually dispatches) and adds
+the step's own stream-slot accounting
+(``step.stream_slot_bytes`` — (1 + lookahead) double-buffered
+layer-group chunk pairs). The plan reports both columns and their
+delta, so predicted-vs-shipped disagreement is a diffable artifact
+(``extrapolation.host_offload_native``); ``bench.py --offload``
+reports the same delta against the priced 13.24 GB in BENCH_r06.
 
 Validation contract (pinned by ``tests/test_jaxcheck.py``): every
 anchor delta within ±10%, and the predicted fit verdict matches the
@@ -218,6 +229,65 @@ def _grad_phase_peak(cfg, state, batch, accum) -> int:
     return est.peak_bytes + 2 * largest_slice
 
 
+def offload_native_rows() -> list[dict]:
+    """Walk the REAL streamed-offload train step (not the
+    :func:`_grad_phase_peak` extrapolation): build
+    ``make_train_step(offload="optimizer")`` abstractly, estimate the
+    jitted grad phase it dispatches, and add its own stream-slot
+    accounting. One row per offload ladder rung, each carrying the
+    native-vs-priced delta the acceptance gate checks."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_rm_tpu.analysis.jaxcheck.costmodel import estimate
+    from kubeflow_rm_tpu.models.llama import LlamaConfig
+    from kubeflow_rm_tpu.parallel import MeshConfig, make_mesh
+    from kubeflow_rm_tpu.training.optim import OptimConfig
+    from kubeflow_rm_tpu.training.train import (
+        TrainConfig, init_train_state, make_train_step,
+    )
+
+    rows = []
+    for preset, batch_rows, accum, seq, label in (
+            ("bench_2_7b", 32, 32, None,
+             "2.7B adafactor mb1 full + streamed host-offload "
+             "optimizer (native)"),
+            ("llama2_7b", 32, 32, 2048,
+             "7B adafactor mb1 full seq2048 + streamed host-offload "
+             "optimizer (native)"),
+    ):
+        kw = {"param_dtype": jnp.bfloat16, "remat_policy": "full"}
+        if seq:
+            kw["max_seq_len"] = seq
+        model = getattr(LlamaConfig, preset)(**kw)
+        cfg = TrainConfig(model=model,
+                          optim=OptimConfig(factored=True,
+                                            offload="optimizer"))
+        state = jax.eval_shape(
+            lambda k, _cfg=cfg: init_train_state(_cfg, k),
+            jax.random.PRNGKey(0))
+        mesh = make_mesh(MeshConfig(), jax.devices()[:1])
+        step = make_train_step(cfg, mesh, state, grad_accum=accum,
+                               offload="optimizer")
+        batch = {k: jax.ShapeDtypeStruct((batch_rows, model.max_seq_len),
+                                         jnp.int32)
+                 for k in ("tokens", "labels")}
+        est = estimate(step.grad_phase, state.params, batch)
+        peak = est.peak_bytes + step.stream_slot_bytes
+        rows.append({
+            "name": label,
+            "preset": preset,
+            "grad_phase_peak_gb": round(est.peak_bytes / GB, 2),
+            "stream_slot_gb": round(step.stream_slot_bytes / GB, 3),
+            "on_chip_peak_gb": round(peak / GB, 2),
+            "fit": bool(peak * (1 + HBM_MARGIN) <= _BUDGET_BYTES),
+            "chunk_layers": cfg.optim.offload_chunk_layers,
+            "chunks": sum(len(c) if c else 1
+                          for c in step.chunk_plan.values()),
+        })
+    return rows
+
+
 def plan_rung(rung: Rung) -> dict:
     import jax
 
@@ -320,6 +390,20 @@ def build_plan() -> dict:
                 round(2 * _tree_bytes(state.params) / GB, 2),
         })
 
+    native = offload_native_rows()
+    agreement = []
+    for priced, nat in zip(offload, native):
+        delta = (100.0 * (nat["on_chip_peak_gb"]
+                          - priced["on_chip_peak_gb"])
+                 / priced["on_chip_peak_gb"])
+        agreement.append({
+            "preset": nat["preset"],
+            "priced_on_chip_peak_gb": priced["on_chip_peak_gb"],
+            "native_on_chip_peak_gb": nat["on_chip_peak_gb"],
+            "delta_pct": round(delta, 1),
+            "verdicts_match": priced["fit"] == nat["fit"],
+        })
+
     full = next(r for r in rows if r["preset"] == "llama2_7b")
     v5p_hbm_gb = 95.74
     per_chip = full["predicted"]["peak_gb"] / 8
@@ -356,6 +440,15 @@ def build_plan() -> dict:
         },
         "extrapolation": {
             "host_offload": offload,
+            "host_offload_native": {
+                "method": "jaxpr walk of the SHIPPED "
+                          "make_train_step(offload='optimizer') grad "
+                          "phase + the step's own double-buffered "
+                          "stream-slot accounting "
+                          "(training/train.py:_build_offload_step)",
+                "rows": native,
+                "agreement_vs_priced": agreement,
+            },
             "conclusion_2_7b": "streaming the optimizer update "
                                "through host RAM AND accumulating "
                                "grads in place (scan-carry "

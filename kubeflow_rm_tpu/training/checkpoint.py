@@ -28,7 +28,15 @@ def _ocp():
 
 def abstract_state(cfg, mesh) -> Any:
     """TrainState of ShapeDtypeStructs carrying NamedShardings — the
-    restore target layout, computed without allocating anything."""
+    restore target layout, computed without allocating anything.
+
+    Under ``cfg.optim.offload == "optimizer"`` the optimizer sub-tree
+    is host-resident (``{leaf_key: per-leaf chain state}`` committed to
+    the CPU backend), so its restore target carries a host
+    SingleDeviceSharding instead of a mesh sharding: a resumed 2.7B
+    run never stages adam moments through HBM, and resume stays
+    bit-exact because the restored leaves land exactly where the
+    streamed step keeps them."""
     # lazy: this module must import on a plain CPU control-plane host
     # (the suspend state store uses latest_step/save/restore on dict
     # pytrees); only model-state restores pull in the train stack
@@ -38,6 +46,13 @@ def abstract_state(cfg, mesh) -> Any:
     shapes = jax.eval_shape(
         lambda: init_train_state(cfg, jax.random.key(0)))
     shardings = state_shardings(cfg, shapes, mesh)
+    if getattr(cfg.optim, "offload", "none") == "optimizer":
+        from jax.sharding import SingleDeviceSharding
+
+        from kubeflow_rm_tpu.training.optim import host_device
+        host = SingleDeviceSharding(host_device())
+        shardings.opt_state = jax.tree.map(lambda _: host,
+                                           shardings.opt_state)
     return jax.tree.map(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         shapes, shardings)

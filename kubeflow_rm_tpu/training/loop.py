@@ -45,6 +45,11 @@ class LoopConfig:
     max_to_keep: int = 3
     seed: int = 0
     grad_accum: int = 1                # microbatches per optimizer step
+    # "none" | "optimizer" | None (follow cfg.optim.offload): the
+    # streamed host-offload arm of make_train_step — optimizer state in
+    # host RAM, per-leaf updates on host, layer-group chunk transfers
+    # double-buffered against them (the MEMPLAN_r01 2.7B recipe)
+    offload: str | None = None
 
 
 @dataclass
@@ -56,6 +61,12 @@ class LoopMetrics:
     tokens_per_sec: float
     mfu_pct: float
     step_time_ms: float
+    # offload arm only (0.0 on the on-chip arm): ms the stream spent
+    # blocked on device->host transfers, and the fraction of the
+    # streaming phase NOT spent blocked — i.e. how much of the
+    # transfer cost the double-buffering hid behind update compute
+    offload_transfer_ms: float = 0.0
+    offload_overlap_frac: float = 0.0
 
 
 def fit(
@@ -116,7 +127,8 @@ def fit(
     if batch_keys is None:
         batch_keys = tuple(first.keys())
     step_fn = make_train_step(cfg, mesh, state, batch_keys=batch_keys,
-                              grad_accum=loop.grad_accum)
+                              grad_accum=loop.grad_accum,
+                              offload=loop.offload)
 
     n_dev = mesh.devices.size
     peak = device_peak_flops(jax.tree_util.tree_leaves(mesh.devices)[0])
@@ -166,6 +178,10 @@ def fit(
                     tokens_per_sec=tps,
                     mfu_pct=100.0 * flops / (n_dev * peak) if peak else 0.0,
                     step_time_ms=1e3 * dt / max(steps_done, 1),
+                    offload_transfer_ms=float(
+                        m.get("offload_transfer_ms", 0.0)),
+                    offload_overlap_frac=float(
+                        m.get("offload_overlap_frac", 0.0)),
                 )
                 history.append(rec)
                 log.info("step %d loss %.4f %.0f tok/s mfu %.1f%%",

@@ -12,15 +12,19 @@ framework's job is the shardings.
 """
 
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Callable
 
 import logging
+import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeflow_rm_tpu.analysis.jaxcheck import hostsync as _hostsync
 from kubeflow_rm_tpu.models import (
     LlamaConfig,
     forward_with_aux,
@@ -28,7 +32,13 @@ from kubeflow_rm_tpu.models import (
 )
 from kubeflow_rm_tpu.ops.losses import softmax_cross_entropy
 from kubeflow_rm_tpu.parallel.sharding import batch_pspec, param_shardings
-from kubeflow_rm_tpu.training.optim import OptimConfig, make_optimizer
+from kubeflow_rm_tpu.training.optim import (
+    OptimConfig,
+    host_device,
+    host_put,
+    make_offload_optimizer,
+    make_optimizer,
+)
 
 
 @dataclass(frozen=True)
@@ -89,11 +99,18 @@ def init_train_state(cfg: TrainConfig, key: jax.Array,
     if params is None:
         params = init_params(cfg.model, key)
     part = _partition_for(cfg, params)
-    opt = make_optimizer(cfg.optim)
-    if part is None:
-        opt_state = opt.init(params)
+    if cfg.optim.offload == "optimizer":
+        # host-resident layout: {leaf_key: per-leaf chain state}, built
+        # leaf-by-leaf on the host device so a 2.7B adam init never
+        # materializes mu/nu in HBM (make_offload_optimizer rejects
+        # the train_only combination)
+        opt_state = make_offload_optimizer(cfg.optim, params).init(params)
     else:
-        opt_state = opt.init(part.split(params)[0])
+        opt = make_optimizer(cfg.optim)
+        if part is None:
+            opt_state = opt.init(params)
+        else:
+            opt_state = opt.init(part.split(params)[0])
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       opt_state=opt_state)
 
@@ -174,7 +191,8 @@ def loss_fn(params, batch, cfg: TrainConfig,
 def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
                     batch_keys: tuple = ("tokens", "labels"),
                     n_microbatches: int | None = None,
-                    grad_accum: int = 1) -> Callable:
+                    grad_accum: int = 1,
+                    offload: str | None = None) -> Callable:
     """Return jitted ``step(state, batch) -> (state, metrics)``.
 
     ``batch`` maps each of ``batch_keys`` to a (B, T) int32 array laid
@@ -194,10 +212,22 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
     double-digit share of step time, and accumulation divides it by K.
     The per-step loss/grads equal the full-batch computation up to
     accumulation-order rounding (asserted by tests/test_train.py).
+
+    ``offload="optimizer"`` (default: ``cfg.optim.offload``) returns
+    the streamed host-offload arm instead: the device runs ONLY the
+    grad-accum phase, then gradients stream host-ward in layer-group
+    chunks double-buffered against the per-leaf optimizer update on
+    the host, and updated params stream back (see
+    ``_build_offload_step``). Loss/params match the on-chip arm
+    bit-for-bit on one backend (tests/test_offload.py).
     """
+    if offload is None:
+        offload = cfg.optim.offload
+    if offload not in ("none", "optimizer"):
+        raise ValueError(f"unknown offload={offload!r} "
+                         "(expected 'none' or 'optimizer')")
     if mesh.shape.get("pp", 1) > 1 and n_microbatches is None:
         n_microbatches = mesh.shape["pp"]
-    opt = make_optimizer(cfg.optim)
     sshard = state_shardings(cfg, state, mesh)
     bshard = {k: NamedSharding(mesh, batch_pspec()) for k in batch_keys}
     mshard = NamedSharding(mesh, P())
@@ -252,12 +282,19 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
         aux = jax.tree_util.tree_map(lambda a: jnp.mean(a, axis=0), auxes)
         return (loss, aux), grads
 
-    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+    def compute_grads(params, batch):
         if grad_accum > 1:
-            (loss, aux), grads = accumulate(state.params, batch)
-        else:
-            (loss, aux), grads = grad_fn(
-                state.params, batch, cfg, mesh, n_microbatches)
+            return accumulate(params, batch)
+        return grad_fn(params, batch, cfg, mesh, n_microbatches)
+
+    if offload == "optimizer":
+        return _build_offload_step(cfg, mesh, state, part, compute_grads,
+                                   bshard, mshard)
+
+    opt = make_optimizer(cfg.optim)
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        (loss, aux), grads = compute_grads(state.params, batch)
         if part is None:
             target, frozen = state.params, None
         else:
@@ -276,6 +313,212 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
         out_shardings=(sshard, mshard),
         donate_argnums=(0,),
     )
+
+
+#: transfer chunks dispatched beyond the one being consumed — the
+#: double-buffer depth of the stream (chunk k updates while k+1..k+2
+#: are in flight), and the multiplier in the on-chip stream-slot
+#: accounting that memplan's native offload walk reuses
+_STREAM_LOOKAHEAD = 2
+
+
+def _build_offload_step(cfg: TrainConfig, mesh: Mesh, state: TrainState,
+                        part, compute_grads, bshard, mshard) -> Callable:
+    """The streamed host-offload arm of ``make_train_step``.
+
+    Two phases per step instead of one fused jit:
+
+    1. **Grad phase (device, one jit).** The grad-accum scan plus the
+       global grad norm. ``state.params`` is donated and passed
+       through, so the scan carry accumulates in place (no
+       double-buffered grads tree — the other half of MEMPLAN_r01's
+       2.7B diagnosis) and the caller's param buffers alias the
+       outputs instead of copying.
+    2. **Streaming phase (host).** Gradient and param leaves stream
+       host-ward in layer-group chunks (``lax.slice_in_dim`` along the
+       stacked-layer axis, ``copy_to_host_async``), double-buffered
+       ``_STREAM_LOOKAHEAD`` chunks deep so chunk k+1's transfer rides
+       under chunk k's work; when a leaf is assembled on host, its
+       per-leaf optimizer update (``OffloadOptimizer.update_leaf`` —
+       arithmetically the on-chip chain) runs on the host device and
+       the updated leaf is dispatched straight back with the param
+       sharding (async H2D). Device-side grad/param leaves are deleted
+       as their last chunk dispatches, so on-chip residency beyond the
+       grad phase stays bounded by the stream slot.
+
+    The update is leaf-granular while transfers are chunk-granular:
+    adafactor's block-RMS clips reduce over whole leaves, so per-chunk
+    updates would change the arithmetic — per-leaf updates keep the
+    offload arm bit-identical to the on-chip arm on a given backend.
+
+    The step donates ``state`` in the same sense the on-chip jit does:
+    param and optimizer buffers are consumed (donated into the grad
+    phase / deleted after streaming), so the caller must rebind
+    ``state`` from the return value.
+    """
+    from collections import deque
+
+    if part is not None:
+        raise ValueError("offload='optimizer' does not compose with "
+                         "train_only — see make_offload_optimizer")
+    if mesh.shape.get("pp", 1) > 1:
+        raise ValueError("offload='optimizer' targets the single-chip "
+                         "memory wall; pp meshes keep the update "
+                         "on-chip (state is already sharded)")
+    opt = make_offload_optimizer(cfg.optim, state.params)
+    keys = opt.keys
+    if not (isinstance(state.opt_state, dict)
+            and set(state.opt_state) == set(keys)):
+        raise ValueError(
+            "state.opt_state is not the host-offload layout; build the "
+            "state with OptimConfig(offload='optimizer') so "
+            "init_train_state lays it out host-resident")
+
+    flat, ptreedef = jax.tree_util.tree_flatten(state.params)
+    shapes = [tuple(p.shape) for p in flat]
+    dtypes = [jnp.dtype(p.dtype) for p in flat]
+    pshard = param_shardings(state.params, mesh)
+    pshard_leaves = jax.tree_util.tree_leaves(pshard)
+
+    # layer-group chunk plan: stacked (L, ...) leaves stream in slices
+    # of offload_chunk_layers along axis 0; flat leaves (embedding,
+    # norms) stream whole
+    chunk_layers = max(1, cfg.optim.offload_chunk_layers)
+    chunks: list[list[tuple[int, int]] | None] = []
+    for shp in shapes:
+        if len(shp) >= 3 and shp[0] > 1:
+            chunks.append([(a, min(a + chunk_layers, shp[0]))
+                           for a in range(0, shp[0], chunk_layers)])
+        else:
+            chunks.append(None)
+
+    def _chunk_bytes(i, r) -> int:
+        shp, item = shapes[i], dtypes[i].itemsize
+        rows = shp[0] if r is None else (r[1] - r[0])
+        per_row = item
+        for d in shp[1:]:
+            per_row *= d
+        return rows * per_row if shp else item
+
+    work: list[tuple[int, tuple[int, int] | None, bool]] = []
+    for i in range(len(flat)):
+        if chunks[i] is None:
+            work.append((i, None, True))
+        else:
+            for j, r in enumerate(chunks[i]):
+                work.append((i, r, j == len(chunks[i]) - 1))
+    max_pair = max((2 * _chunk_bytes(i, r) for i, r, _ in work), default=0)
+    # grad + param slices per chunk, one consumed + LOOKAHEAD in flight
+    stream_slot_bytes = (1 + _STREAM_LOOKAHEAD) * max_pair
+
+    def grad_phase(params, batch):
+        (loss, aux), grads = compute_grads(params, batch)
+        gnorm = optax.global_norm(grads)
+        return params, grads, loss, gnorm, aux
+
+    grad_phase_j = jax.jit(
+        grad_phase,
+        in_shardings=(pshard, bshard),
+        out_shardings=(pshard, pshard, mshard, mshard, mshard),
+        donate_argnums=(0,),
+    )
+
+    @partial(jax.jit, static_argnames=("key",), donate_argnums=(0,))
+    def _leaf_update(opt_leaf_state, grad, param, gnorm, *, key):
+        return opt.update_leaf(key, opt_leaf_state, grad, param, gnorm)
+
+    host = host_device()
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        params_thru, grads, loss, gnorm, aux = grad_phase_j(
+            state.params, batch)
+        new_step = state.step + 1
+        g_leaves = jax.tree_util.tree_leaves(grads)
+        p_leaves = jax.tree_util.tree_leaves(params_thru)
+        new_p_leaves: list = [None] * len(p_leaves)
+        new_opt: dict = {}
+        blocked = 0.0
+        t_stream = time.perf_counter()
+        with _hostsync.sanctioned("train.offload_stream"):
+            inflight: deque = deque()
+            pos = 0
+
+            def dispatch_next():
+                nonlocal pos
+                i, r, last = work[pos]
+                pos += 1
+                g, p = g_leaves[i], p_leaves[i]
+                if r is None:
+                    gsl, psl = g, p
+                else:
+                    gsl = jax.lax.slice_in_dim(g, r[0], r[1])
+                    psl = jax.lax.slice_in_dim(p, r[0], r[1])
+                gsl.copy_to_host_async()
+                psl.copy_to_host_async()
+                if r is not None and last:
+                    # the slices carry the data from here on: free the
+                    # device-resident source leaves so on-chip residency
+                    # past the grad phase is just the stream slot
+                    g.delete()
+                    p.delete()
+                return gsl, psl
+
+            for _ in range(min(1 + _STREAM_LOOKAHEAD, len(work))):
+                inflight.append(dispatch_next())
+
+            t1 = time.perf_counter()
+            gnorm_host = jax.device_put(np.asarray(gnorm), host)
+            blocked += time.perf_counter() - t1
+
+            for i, key in enumerate(keys):
+                n_chunks = 1 if chunks[i] is None else len(chunks[i])
+                parts_g, parts_p = [], []
+                for _ in range(n_chunks):
+                    gsl, psl = inflight.popleft()
+                    t1 = time.perf_counter()
+                    parts_g.append(np.asarray(gsl))
+                    parts_p.append(np.asarray(psl))
+                    blocked += time.perf_counter() - t1
+                    if pos < len(work):
+                        inflight.append(dispatch_next())
+                gh = (parts_g[0] if n_chunks == 1
+                      else np.concatenate(parts_g, axis=0))
+                ph = (parts_p[0] if n_chunks == 1
+                      else np.concatenate(parts_p, axis=0))
+                leaf_state = jax.tree_util.tree_map(
+                    host_put, state.opt_state[key])
+                new_p_host, new_opt[key] = _leaf_update(
+                    leaf_state,
+                    jax.device_put(gh, host),
+                    jax.device_put(ph, host),
+                    gnorm_host, key=key)
+                # async H2D: the next leaf's transfers and update
+                # overlap this dispatch
+                new_p_leaves[i] = jax.device_put(new_p_host,
+                                                 pshard_leaves[i])
+                if chunks[i] is None:
+                    # whole-leaf transfers: the host copy exists, free
+                    # the device source now rather than at step exit
+                    g_leaves[i].delete()
+                    p_leaves[i].delete()
+        stream_wall = time.perf_counter() - t_stream
+        params = jax.tree_util.tree_unflatten(ptreedef, new_p_leaves)
+        metrics = {
+            "loss": loss, "grad_norm": gnorm, **aux,
+            "offload_transfer_ms": blocked * 1e3,
+            "offload_overlap_frac": (max(0.0, 1.0 - blocked / stream_wall)
+                                     if stream_wall > 0 else 0.0),
+        }
+        return TrainState(step=new_step, params=params,
+                          opt_state=new_opt), metrics
+
+    # introspection surface: memplan's native offload walk estimates
+    # the grad phase and adds the stream slot; tests assert the plan
+    step.grad_phase = grad_phase_j
+    step.stream_slot_bytes = stream_slot_bytes
+    step.chunk_plan = dict(zip(keys, chunks))
+    step.offload = "optimizer"
+    return step
 
 
 def shard_batch(batch: dict, mesh: Mesh) -> dict:

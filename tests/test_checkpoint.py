@@ -98,3 +98,65 @@ def test_fit_rejects_nonpositive_log_every(mesh):
                              vocab_size=cfg.model.vocab_size)
     with pytest.raises(ValueError, match="log_every"):
         fit(cfg, mesh, data, LoopConfig(total_steps=2, log_every=0))
+
+
+# -- host-offload optimizer state (r18) -------------------------------------
+
+def _offload_cfg():
+    from kubeflow_rm_tpu.training.optim import OptimConfig
+    return TrainConfig(model=LlamaConfig.tiny(),
+                       optim=OptimConfig(factored=True, offload="optimizer"))
+
+
+def test_checkpoint_roundtrip_offload_opt_state(tmp_path, mesh):
+    """Host-resident optimizer state survives an orbax roundtrip and
+    restores back onto the HOST device, not the mesh — a resumed
+    offload run must never stage adafactor stats through HBM."""
+    from kubeflow_rm_tpu.training.optim import host_device
+    cfg = _offload_cfg()
+    state = init_train_state(cfg, jax.random.key(0))
+    with Checkpointer(tmp_path / "ckpt") as ck:
+        ck.save(state, force=True)
+        ck.wait()
+        restored = ck.restore(cfg, mesh)
+    for a, b in zip(jax.tree.leaves(state.opt_state),
+                    jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    host = host_device()
+    for leaf in jax.tree.leaves(restored.opt_state):
+        if hasattr(leaf, "devices"):
+            assert leaf.devices() == {host}
+    # params still restore onto the mesh as usual
+    leaf = jax.tree.leaves(restored.params)[0]
+    assert leaf.sharding.mesh.shape == mesh.shape
+
+
+def test_fit_resumes_exactly_with_offload(tmp_path, mesh):
+    """Kill-and-resume with the streamed offload step lands on the
+    same step with bit-identical params AND optimizer state as the
+    uninterrupted run — resume replays the same deterministic stream
+    through the same host-side update arithmetic."""
+    cfg = _offload_cfg()
+
+    def data():
+        return synthetic_batches(batch_size=8, seq_len=32,
+                                 vocab_size=cfg.model.vocab_size)
+
+    loop_kw = dict(log_every=3, seed=7, offload="optimizer")
+    full, _ = fit(cfg, mesh, data(),
+                  LoopConfig(total_steps=6, **loop_kw))
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    fit(cfg, mesh, data(),
+        LoopConfig(total_steps=3, checkpoint_dir=ckpt_dir, **loop_kw))
+    resumed, history = fit(
+        cfg, mesh, data(),
+        LoopConfig(total_steps=6, checkpoint_dir=ckpt_dir, **loop_kw))
+    assert int(resumed.step) == 6
+    assert [h.step for h in history] == [6]
+    for a, b in zip(jax.tree.leaves(full.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(full.opt_state),
+                    jax.tree.leaves(resumed.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

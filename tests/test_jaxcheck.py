@@ -244,3 +244,47 @@ def test_hostsync_disabled_region_is_free():
     hostsync.set_enabled(False)
     cm = hostsync.region("anything")
     assert cm is hostsync.region("anything-else")   # shared null CM
+
+
+def test_hostsync_sanctioned_tallies_instead_of_witnessing(probe):
+    """The offload stream's escape hatch: syncs under sanctioned() are
+    counted per (site, kind), not witnessed — the probe stays useful
+    as observability while the deliberate transfers stop tripping it."""
+    x = jnp.asarray(3.0)
+    with probe.region("train.step"):
+        with probe.sanctioned("train.offload_stream"):
+            np.asarray(x)
+            float(x)
+    assert probe.witnesses() == []
+    counts = probe.sanctioned_counts()
+    assert counts[("train.offload_stream", "np.asarray")] == 1
+    assert counts[("train.offload_stream", "__float__")] == 1
+
+
+def test_hostsync_unsanctioned_sync_still_trips(probe):
+    """Teeth check: a sync in the same hot region but OUTSIDE the
+    sanctioned context is still a witness — sanctioning one site must
+    not blanket the whole region."""
+    x = jnp.asarray(4.0)
+    with probe.region("train.step"):
+        with probe.sanctioned("train.offload_stream"):
+            np.asarray(x)
+        float(x)                  # the bug the probe exists to catch
+    kinds = [w["kind"] for w in probe.witnesses()]
+    assert kinds == ["__float__"]
+    assert probe.witnesses()[0]["region"] == "train.step"
+
+
+def test_hostsync_sanctioned_disabled_is_free():
+    hostsync.set_enabled(False)
+    cm = hostsync.sanctioned("any-site")
+    assert cm is hostsync.sanctioned("other-site")  # shared null CM
+
+
+def test_hostsync_reset_clears_sanctioned_tallies(probe):
+    x = jnp.asarray(5.0)
+    with probe.region("r"), probe.sanctioned("s"):
+        float(x)
+    assert probe.sanctioned_counts()
+    probe.reset()
+    assert probe.sanctioned_counts() == {}

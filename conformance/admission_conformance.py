@@ -1,0 +1,259 @@
+#!/usr/bin/env python3
+"""Predictive-admission conformance: the jaxcheck pricer in the
+webhook path, end to end, plus the HBM-aware packing A/B storm.
+
+Phase A — admission e2e. A Notebook declaring a provably-OOM training
+config (``tpu.kubeflow.org/declared-workload``) is created through the
+real control plane: the webhook prices the declaration with the
+memplan walker and the CR is **rejected before placement** — verdict
+and priced explanation in ``status.admission``, an ``AdmissionRejected``
+Warning event, zero pods rendered. The advisor's cheapest passing
+ladder rung is then pasted back via UPDATE and the same CR admits AND
+schedules to Running. No TPU ever saw the OOM config.
+
+Phase B — packing A/B storm. The SAME mix of declared slices (equal
+chip totals per arm) is spawned twice: once with chip-count-only
+admission (the baseline arm), once with ``--hbm-packing``
+(``scheduler.set_hbm_packing``) where predicted HBM is the second
+packing axis and declared slices may share a node's chips (bounded)
+because HBM — the axis that actually OOMs — is never overcommitted.
+The HBM arm must admit strictly more of the mix, and every node must
+end the storm with ``hbm_used <= hbm_capacity``.
+
+The artifact (``ADMIT_r01.json`` / ``ADMIT_ci.json``) carries both
+phases plus the shared run_meta header benchmarks/ratchet.py keys on.
+
+Usage:
+    python conformance/admission_conformance.py --out ADMIT_r01.json
+    python conformance/admission_conformance.py --arm hbm --nodes 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from kubeflow_rm_tpu.controlplane import (  # noqa: E402
+    make_control_plane,
+    scheduler,
+)
+from kubeflow_rm_tpu.controlplane.api import tpu as tpu_api  # noqa: E402
+from kubeflow_rm_tpu.controlplane.api.meta import (  # noqa: E402
+    deep_get,
+    set_annotation,
+)
+from kubeflow_rm_tpu.controlplane.api.notebook import (  # noqa: E402
+    make_notebook,
+)
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (  # noqa: E402
+    make_tpu_node,
+)
+
+NS = "admit"
+
+#: phase A: a real 1.3B bench preset that provably OOMs a v5litepod-8
+#: (22.85 predicted GB/chip vs the 16.91 GB usable budget — the
+#: microbatch-32 logits+workspace bind); the advisor's grad_accum=2
+#: rung fits the same slice
+OOM_DECL = {"preset": "bench_1b", "optim": "adamw", "seq": 4096,
+            "batch": 32, "grad_accum": 1, "tenant": "teamA"}
+
+#: phase B: tiny-model declarations (sub-second traces) whose LOGITS
+#: dominate — heavy ~50 GB and light ~25 GB predicted slice peaks, so
+#: a 128-GiB v5e host packs 2 heavy or a heavy+light+light, while
+#: chip-count-only admission packs exactly one 8-chip slice per node
+_TINY = {"model": {"dim": 64, "n_layers": 2, "n_heads": 4,
+                   "n_kv_heads": 4, "hidden_dim": 256,
+                   "vocab_size": 32000},
+         "seq": 4096, "batch": 256, "optim": "adamw", "remat": "full"}
+HEAVY_DECL = {**_TINY, "grad_accum": 8, "tenant": "teamB"}
+LIGHT_DECL = {**_TINY, "grad_accum": 16, "tenant": "teamC"}
+
+
+def _run_meta(args, arms_extra: dict) -> dict:
+    from kubeflow_rm_tpu.controlplane.obs.runmeta import build_run_meta
+    arms = {"accelerator": args.accelerator, "nodes": args.nodes,
+            "heavy": args.heavy, "light": args.light}
+    arms.update(arms_extra)
+    return build_run_meta("admission_conformance", arms)
+
+
+def _stack(args):
+    api, mgr = make_control_plane()
+    api.ensure_namespace(NS)
+    for i in range(args.nodes):
+        api.create(make_tpu_node(f"tpu-{i}", args.accelerator))
+    return api, mgr
+
+
+# ---- phase A: the admission e2e --------------------------------------
+
+def e2e_main(args) -> dict:
+    api, mgr = _stack(args)
+    t0 = time.perf_counter()
+    api.create(make_notebook(
+        "oom", NS, accelerator_type=args.accelerator,
+        annotations={tpu_api.DECLARED_WORKLOAD_ANNOTATION:
+                     json.dumps(OOM_DECL)}))
+    mgr.run_until_idle()
+    reject_ms = (time.perf_counter() - t0) * 1000
+    nb = api.get("Notebook", "oom", NS)
+    adm = deep_get(nb, "status", "admission") or {}
+    assert adm.get("verdict") == "rejected", \
+        f"OOM declaration not rejected: {adm}"
+    pods = api.list("Pod", NS)
+    assert pods == [], f"rejected CR rendered {len(pods)} pods"
+    events = [e["reason"] for e in api.events_for(nb)]
+    assert "AdmissionRejected" in events, events
+    advice = adm.get("advisor")
+    assert advice, "rejection carries no advisor rung"
+
+    # paste the advisor's rung back: the SAME CR admits and schedules
+    t1 = time.perf_counter()
+    set_annotation(nb, tpu_api.DECLARED_WORKLOAD_ANNOTATION,
+                   json.dumps(advice["workload"]))
+    api.update(nb)
+    mgr.run_until_idle()
+    admit_ms = (time.perf_counter() - t1) * 1000
+    nb = api.get("Notebook", "oom", NS)
+    assert deep_get(nb, "status", "admission", "verdict") == "fit"
+    pods = api.list("Pod", NS)
+    assert pods and all(
+        deep_get(p, "status", "phase") == "Running" for p in pods), \
+        "advisor rung did not schedule"
+    print(f"phase A: rejected in {reject_ms:.0f}ms "
+          f"({adm['predicted_peak_per_chip_gb']} GB/chip vs "
+          f"{adm['budget_per_chip_gb']} budget, {adm['binds']} binds); "
+          f"advisor rung admitted+Running in {admit_ms:.0f}ms",
+          file=sys.stderr)
+    return {
+        "declared": OOM_DECL,
+        "verdict": adm["verdict"],
+        "explanation": adm["explanation"],
+        "predicted_peak_per_chip_gb": adm["predicted_peak_per_chip_gb"],
+        "budget_per_chip_gb": adm["budget_per_chip_gb"],
+        "binds": adm["binds"],
+        "pods_rendered_while_rejected": 0,
+        "advisor_rung": advice["workload"],
+        "advisor_note": advice["note"],
+        "rung_running_pods": len(pods),
+        "reject_ms": round(reject_ms, 1),
+        "rung_admit_ms": round(admit_ms, 1),
+    }
+
+
+# ---- phase B: the packing A/B storm ----------------------------------
+
+def _storm_arm(args, hbm: bool) -> dict:
+    """Spawn the declared mix on a fresh fleet under one packing arm."""
+    scheduler.set_hbm_packing(hbm)
+    try:
+        api, mgr = _stack(args)
+        mix = ([("heavy", HEAVY_DECL)] * args.heavy
+               + [("light", LIGHT_DECL)] * args.light)
+        t0 = time.perf_counter()
+        for i, (kind, decl) in enumerate(mix):
+            api.create(make_notebook(
+                f"{kind}-{i}", NS, accelerator_type=args.accelerator,
+                annotations={tpu_api.DECLARED_WORKLOAD_ANNOTATION:
+                             json.dumps(decl)}))
+        reconciles = mgr.run_until_idle()
+        wall_ms = (time.perf_counter() - t0) * 1000
+        running = pending = 0
+        for i, (kind, _) in enumerate(mix):
+            nb = api.get("Notebook", f"{kind}-{i}", NS)
+            hosts = deep_get(nb, "status", "desiredReplicas", default=1)
+            ready = deep_get(nb, "status", "readyReplicas", default=0)
+            if hosts and ready >= hosts:
+                running += 1
+            else:
+                pending += 1
+        by_node = scheduler.cache_for(api).hbm_by_node()
+        overcommitted = [n for n, (used, cap) in by_node.items()
+                        if cap > 0 and used > cap + 1e-3]
+        chips_admitted = sum(
+            scheduler.cache_for(api).node_used(n) for n in by_node)
+        return {
+            "hbm_packing": hbm,
+            "slices_in_mix": len(mix),
+            "admitted_running": running,
+            "refused_pending": pending,
+            "chips_bound": chips_admitted,
+            "hbm_by_node_gib": {n: [round(u, 1), round(c, 1)]
+                                for n, (u, c) in sorted(by_node.items())},
+            "overcommitted_nodes": overcommitted,
+            "reconciles": reconciles,
+            "wall_ms": round(wall_ms, 1),
+        }
+    finally:
+        scheduler.set_hbm_packing(False)
+
+
+def storm_main(args) -> dict:
+    arms = {}
+    if args.arm in ("both", "chip"):
+        arms["chip"] = _storm_arm(args, hbm=False)
+    if args.arm in ("both", "hbm"):
+        arms["hbm"] = _storm_arm(args, hbm=True)
+    for name, arm in arms.items():
+        assert arm["overcommitted_nodes"] == [], \
+            f"{name} arm overcommitted HBM on {arm['overcommitted_nodes']}"
+        print(f"phase B [{name}]: {arm['admitted_running']}/"
+              f"{arm['slices_in_mix']} slices Running, "
+              f"hbm_by_node={arm['hbm_by_node_gib']}", file=sys.stderr)
+    if args.arm == "both":
+        # the tentpole claim: same chip totals offered, the HBM arm
+        # admits a mix the chip-count arm refuses — with zero
+        # predicted-HBM overcommit anywhere
+        assert arms["hbm"]["admitted_running"] > \
+            arms["chip"]["admitted_running"], (
+                "HBM arm admitted no more than the chip arm: "
+                f"{arms['hbm']['admitted_running']} vs "
+                f"{arms['chip']['admitted_running']}")
+    return arms
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--accelerator", default="v5litepod-8",
+                    help="slice type for every spawned notebook")
+    ap.add_argument("--nodes", type=int, default=2,
+                    help="fake TPU nodes in the fleet")
+    ap.add_argument("--heavy", type=int, default=4,
+                    help="slices declaring the ~50 GB workload")
+    ap.add_argument("--light", type=int, default=4,
+                    help="slices declaring the ~25 GB workload")
+    ap.add_argument("--arm", choices=("both", "chip", "hbm"),
+                    default="both",
+                    help="packing arm(s) for the phase-B storm")
+    ap.add_argument("--skip-e2e", action="store_true",
+                    help="phase B only (skip the priced-rejection e2e)")
+    ap.add_argument("--skip-storm", action="store_true",
+                    help="phase A only")
+    ap.add_argument("--out", default="",
+                    help="write the ADMIT artifact JSON here")
+    args = ap.parse_args()
+
+    result: dict = {
+        "run_meta": _run_meta(args, {"arm": args.arm,
+                                     "hbm_packing": "ab"}),
+        "harness": "admission_conformance",
+    }
+    if not args.skip_e2e:
+        result["e2e"] = e2e_main(args)
+    if not args.skip_storm:
+        result["packing_storm"] = storm_main(args)
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

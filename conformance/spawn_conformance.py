@@ -77,6 +77,9 @@ def _run_meta(args, mode: str) -> dict:
         arms.update(max_shards=args.max_shards,
                     objects=args.diurnal_objects,
                     chaos_split=bool(args.chaos_split),
+                    arrival="open" if args.arrival_rate > 0
+                    else "closed",
+                    arrival_rate=args.arrival_rate,
                     seed=args.seed)
     return build_run_meta(
         "spawn_conformance", arms,
@@ -935,11 +938,42 @@ def diurnal_main(args) -> int:
     def pump(objs: list[dict], phase: str) -> float:
         """Run one load wave through the pool while the main thread
         ticks observer + autoscaler — splits/merges land DURING the
-        wave, so the fence/remap window sees live writers."""
+        wave, so the fence/remap window sees live writers.
+
+        Two load models:
+
+        - closed loop (default): every object is submitted at once and
+          the pool's width throttles arrivals to completion rate — the
+          legacy saturating wave;
+        - open loop (``--arrival-rate R``): object *i* arrives at
+          ``t0 + i/R`` whether or not earlier creates finished — the
+          production shape, where demand does not politely wait for
+          the fleet. Backlog (and so federated workqueue depth) builds
+          whenever R outruns reconcile throughput, which is what lets
+          the autoscaler reach the envelope on SIGNALS alone instead
+          of needing the evening's forced-split floor.
+        """
         t0 = time.monotonic()
-        with ThreadPoolExecutor(
-                max_workers=max(4, args.concurrency)) as pool:
-            futs = [pool.submit(track, o) for o in objs]
+        rate = args.arrival_rate
+        # open loop needs headroom: in-flight creates must not cap the
+        # arrival process, or it degenerates back into a closed loop
+        width = max(4, args.concurrency) if rate <= 0 else \
+            max(16, args.concurrency)
+        with ThreadPoolExecutor(max_workers=width) as pool:
+            futs = []
+            if rate > 0:
+                for i, o in enumerate(objs):
+                    due = t0 + i / rate
+                    while True:
+                        now = time.monotonic()
+                        if now >= due:
+                            break
+                        observer.tick()
+                        scaler.tick()
+                        time.sleep(min(0.15, due - now))
+                    futs.append(pool.submit(track, o))
+            else:
+                futs = [pool.submit(track, o) for o in objs]
             while any(not f.done() for f in futs):
                 observer.tick()
                 scaler.tick()
@@ -1002,10 +1036,14 @@ def diurnal_main(args) -> int:
                 for i in range(n_evening)]
         dt = pump(wave, "evening flood")
         # the envelope floor: whatever the signals did not claim by
-        # dusk is forced through the same handoff path
-        while len(router.ring) < args.max_shards:
-            name = elastic.split()
-            forced.append({"op": "split", "shard": name})
+        # dusk is forced through the same handoff path. Closed loop
+        # only — the open-loop arm must reach the envelope on pressure
+        # alone (it asserts zero forced splits below, and the peak may
+        # legitimately have come and gone mid-wave as backlog drained)
+        if args.arrival_rate <= 0:
+            while len(router.ring) < args.max_shards:
+                name = elastic.split()
+                forced.append({"op": "split", "shard": name})
         phases_out.append({"phase": "evening", "objects": n_evening,
                            "shards_after": len(router.ring),
                            "duration_s": round(dt, 1)})
@@ -1067,6 +1105,9 @@ def diurnal_main(args) -> int:
                 "max_reached": max_seen,
                 "final_shards": len(router.ring),
             },
+            "arrival": {"mode": "open" if args.arrival_rate > 0
+                        else "closed",
+                        "rate_per_s": args.arrival_rate},
             "splits_total": splits,
             "merges_total": merges,
             "forced_scale_steps": forced,
@@ -1105,6 +1146,14 @@ def diurnal_main(args) -> int:
         assert splits >= 1 and merges >= 1, (splits, merges)
         assert max_seen >= args.max_shards, \
             f"never reached {args.max_shards} shards (peak {max_seen})"
+        if args.arrival_rate > 0:
+            # the open-loop contract: demand pressure alone must carry
+            # the fleet to the envelope — the evening's forced-split
+            # floor exists for the closed-loop arm, not this one
+            forced_splits = [f for f in forced if f["op"] == "split"]
+            assert not forced_splits, (
+                f"open-loop run needed {len(forced_splits)} forced "
+                f"split(s): the arrival rate never outran the fleet")
         assert len(router.ring) == min_shards
         if plan is None:
             # satellite: deliberate scale-downs are not deaths — the
@@ -1119,7 +1168,7 @@ def diurnal_main(args) -> int:
     finally:
         if plan is not None:
             chaos.uninstall()
-        suspend.set_active_defrag(False)
+        suspend.set_active_defrag(True)  # restore the library default
         runner.stop()
         shutil.rmtree(base_dir, ignore_errors=True)
 
@@ -1229,13 +1278,26 @@ def main() -> int:
                          "loss")
     ap.add_argument("--seed", type=int, default=1234,
                     help="chaos seed for --chaos-split")
-    ap.add_argument("--active-defrag", action="store_true",
-                    help="promote compaction migration from last-"
-                         "resort to an active fragmentation-driven "
-                         "placement policy (scheduler idle passes "
-                         "migrate one victim whenever doing so grows "
-                         "the largest free contiguous block) — the "
-                         "defrag A/B arm")
+    ap.add_argument("--arrival-rate", type=float, default=0.0,
+                    metavar="R",
+                    help="diurnal mode: OPEN-LOOP load — object i of "
+                         "each wave arrives at t0 + i/R (objects/s) "
+                         "whether or not earlier creates finished, so "
+                         "backlog builds whenever R outruns the "
+                         "fleet's reconcile throughput and the "
+                         "autoscaler reaches the envelope on signals "
+                         "alone (the run asserts ZERO forced splits); "
+                         "0 = the legacy closed-loop saturating wave")
+    ap.add_argument("--active-defrag",
+                    action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="active fragmentation-driven placement "
+                         "(scheduler idle passes migrate one victim "
+                         "whenever doing so grows the largest free "
+                         "contiguous block). Default ON since the "
+                         "ratchet A/B proved the admission-latency "
+                         "win; --no-active-defrag is the last-resort-"
+                         "only baseline arm")
     ap.add_argument("--hang-dump", type=float, default=0.0, metavar="S",
                     help="arm faulthandler to dump every thread's "
                          "stack after S seconds (CI contention-stress "

@@ -12,6 +12,10 @@ the chip. Four probes, one artifact:
 - :mod:`.memplan` — runs the cost model over the full-FT ladder and
   emits ``MEMPLAN_r01.json``, validated against the measured
   BENCH_SWEEP_r05 rungs and extrapolated to the 7B north star;
+- :mod:`.pricer` — the memplan walker shaped for the control plane's
+  admission path: parses+bounds a declared-workload annotation, prices
+  it against the slice's HBM budget (memoized), and runs the
+  auto-config advisor ladder for rejected configs;
 - :mod:`.recompile` — an opt-in jit-cache sentinel
   (``KFRM_JIT_SENTINEL=1``, zero cost when off) that records
   (shape, dtype, static-arg) signatures per jitted entry point and

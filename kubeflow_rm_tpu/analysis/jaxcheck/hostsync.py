@@ -55,6 +55,7 @@ _STACK_LIMIT = 12
 # instrumentation must not recurse into the instrumented layer
 # (same exemption lockgraph.py itself takes).
 _lock = threading.Lock()  # kfrm: disable=KFRM001
+_observers: list = []
 _witnesses: list[dict] = []
 _sanctioned_counts: dict[tuple[str, str], int] = {}  # (site, kind) -> n
 _installed = False
@@ -159,6 +160,24 @@ def _record(kind: str) -> None:
             "region": stack[-1],
             "stack": "".join(frames),
         })
+        observers = list(_observers)
+    for fn in observers:
+        fn(stack[-1], kind)
+
+
+def add_observer(fn) -> None:
+    """``fn(region, kind)`` on every UNSANCTIONED implicit sync inside
+    an open region — the control plane's fleet-SLO bridge hangs here.
+    Idempotent per callable; observers fire outside the probe lock."""
+    with _lock:
+        if fn not in _observers:
+            _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    with _lock:
+        if fn in _observers:
+            _observers.remove(fn)
 
 
 def _wrap(cls, name: str):

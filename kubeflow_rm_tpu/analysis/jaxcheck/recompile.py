@@ -50,6 +50,23 @@ _STACK_LIMIT = 12
 _lock = threading.Lock()  # kfrm: disable=KFRM001
 _entries: dict[str, dict] = {}
 _tracked: dict[str, object] = {}
+_observers: list = []
+
+
+def add_observer(fn) -> None:
+    """``fn(entry, n_signatures)`` on every NEW compile signature —
+    the control plane's fleet-SLO bridge hangs here (the probe itself
+    stays importable without the control plane). Idempotent per
+    callable; observers fire outside the probe lock."""
+    with _lock:
+        if fn not in _observers:
+            _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    with _lock:
+        if fn in _observers:
+            _observers.remove(fn)
 
 
 def enabled() -> bool:
@@ -131,17 +148,21 @@ def note(entry: str, *args, **static) -> None:
             seen[sig] += 1
             return
         seen[sig] = 1
+        n = len(seen)
         limit = e["limit"]
-        if limit is not None and len(seen) > limit:
+        if limit is not None and n > limit:
             stack = traceback.format_list(
                 traceback.extract_stack(limit=_STACK_LIMIT)[:-1])
             e["witnesses"].append({
                 "entry": entry,
                 "signature": sig,
-                "count": len(seen),
+                "count": n,
                 "limit": limit,
                 "stack": "".join(stack),
             })
+        observers = list(_observers)
+    for fn in observers:
+        fn(entry, n)
 
 
 def cache_size(entry: str) -> int | None:

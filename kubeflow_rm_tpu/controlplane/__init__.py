@@ -68,6 +68,9 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     from kubeflow_rm_tpu.controlplane.webhook.tpu_inject import (
         TpuInjectWebhook,
     )
+    from kubeflow_rm_tpu.controlplane.webhook.admission_pricer import (
+        AdmissionPricer,
+    )
 
     api = APIServer(global_lock=global_lock,
                     **({"clock": clock} if clock else {}))
@@ -75,10 +78,12 @@ def make_control_plane(clock=None, *, auto_ready: bool = True,
     api.register_validator(pd_api.KIND, pd_api.validate)
     api.register_validator(tj_api.KIND, tj_api.validate)
 
-    # admission order: notebook webhook on Notebooks; for pods, the
-    # PodDefault merge runs before TPU injection (injection must see the
-    # final container set, sidecars included)
+    # admission order: notebook webhook on Notebooks (the pricer runs
+    # after it so a priced status survives the lock injection); for
+    # pods, the PodDefault merge runs before TPU injection (injection
+    # must see the final container set, sidecars included)
     NotebookWebhook(api).register()
+    AdmissionPricer(api).register()
     PodDefaultWebhook(api).register()
     TpuInjectWebhook(api).register()
 

@@ -21,8 +21,26 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 GOOGLE_TPU_RESOURCE = "google.com/tpu"
+#: synthetic allocatable key carrying a node's aggregate HBM (GiB as a
+#: decimal string) — the scheduler's second packing axis. Real GKE
+#: exposes HBM only through the accelerator type; the fake kubelet
+#: surfaces it as a first-class quantity so per-node accounting mirrors
+#: the chip/cpu axes exactly.
+GOOGLE_TPU_HBM_RESOURCE = "google.com/tpu-hbm-gib"
 NODE_LABEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 NODE_LABEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+# ---- predictive admission vocabulary (tpu.kubeflow.org/) -------------
+#: JSON declaration of the training workload a Notebook/TPUJob intends
+#: to run (preset or explicit model dims + optim/batch/accum/remat/seq/
+#: dtype/offload knobs) — priced by the memplan walker at admission
+DECLARED_WORKLOAD_ANNOTATION = "tpu.kubeflow.org/declared-workload"
+#: stamped by the admission pricer: predicted peak HBM for the whole
+#: slice (decimal GB, float as str) and predicted FLOPs per step —
+#: controllers fan the per-pod share onto pod templates, the scheduler
+#: packs on it
+PREDICTED_HBM_ANNOTATION = "tpu.kubeflow.org/predicted-hbm-gb"
+PREDICTED_FLOPS_ANNOTATION = "tpu.kubeflow.org/predicted-flops"
 
 
 @dataclass(frozen=True)
@@ -33,6 +51,7 @@ class SliceTopology:
     chips: int              # total chips in the slice
     hosts: int              # pods per slice (one per host)
     chip_flops_bf16: float  # peak dense bf16 FLOPs/sec per chip
+    hbm_gib_per_chip: float = 16.0  # HBM per chip (GiB)
 
     @property
     def chips_per_host(self) -> int:
@@ -41,6 +60,10 @@ class SliceTopology:
     @property
     def multihost(self) -> bool:
         return self.hosts > 1
+
+    @property
+    def hbm_gib_per_host(self) -> float:
+        return self.chips_per_host * self.hbm_gib_per_chip
 
 
 _V5E = "tpu-v5-lite-podslice"
@@ -60,40 +83,40 @@ _TOPOLOGIES = [
     SliceTopology("v5litepod-256", _V5E, "16x16", 256, 64, 197e12),
     # v5p: 2 TensorCores/chip, 4-chip hosts, 3D torus topologies up to
     # the full 8960-chip pod (cube-ish shapes, the GKE-offered set)
-    SliceTopology("v5p-8", _V5P, "2x2x1", 4, 1, 459e12),
-    SliceTopology("v5p-16", _V5P, "2x2x2", 8, 2, 459e12),
-    SliceTopology("v5p-32", _V5P, "2x2x4", 16, 4, 459e12),
-    SliceTopology("v5p-64", _V5P, "2x4x4", 32, 8, 459e12),
-    SliceTopology("v5p-128", _V5P, "4x4x4", 64, 16, 459e12),
-    SliceTopology("v5p-256", _V5P, "4x4x8", 128, 32, 459e12),
-    SliceTopology("v5p-512", _V5P, "4x8x8", 256, 64, 459e12),
-    SliceTopology("v5p-1024", _V5P, "8x8x8", 512, 128, 459e12),
-    SliceTopology("v5p-2048", _V5P, "8x8x16", 1024, 256, 459e12),
-    SliceTopology("v5p-4096", _V5P, "8x16x16", 2048, 512, 459e12),
-    SliceTopology("v5p-8192", _V5P, "16x16x16", 4096, 1024, 459e12),
-    SliceTopology("v5p-12288", _V5P, "16x16x24", 6144, 1536, 459e12),
+    SliceTopology("v5p-8", _V5P, "2x2x1", 4, 1, 459e12, 95.0),
+    SliceTopology("v5p-16", _V5P, "2x2x2", 8, 2, 459e12, 95.0),
+    SliceTopology("v5p-32", _V5P, "2x2x4", 16, 4, 459e12, 95.0),
+    SliceTopology("v5p-64", _V5P, "2x4x4", 32, 8, 459e12, 95.0),
+    SliceTopology("v5p-128", _V5P, "4x4x4", 64, 16, 459e12, 95.0),
+    SliceTopology("v5p-256", _V5P, "4x4x8", 128, 32, 459e12, 95.0),
+    SliceTopology("v5p-512", _V5P, "4x8x8", 256, 64, 459e12, 95.0),
+    SliceTopology("v5p-1024", _V5P, "8x8x8", 512, 128, 459e12, 95.0),
+    SliceTopology("v5p-2048", _V5P, "8x8x16", 1024, 256, 459e12, 95.0),
+    SliceTopology("v5p-4096", _V5P, "8x16x16", 2048, 512, 459e12, 95.0),
+    SliceTopology("v5p-8192", _V5P, "16x16x16", 4096, 1024, 459e12, 95.0),
+    SliceTopology("v5p-12288", _V5P, "16x16x24", 6144, 1536, 459e12, 95.0),
     # v4: 2 TensorCores/chip, 4-chip hosts, up to the 3072-chip pod
-    SliceTopology("v4-8", _V4, "2x2x1", 4, 1, 275e12),
-    SliceTopology("v4-16", _V4, "2x2x2", 8, 2, 275e12),
-    SliceTopology("v4-32", _V4, "2x2x4", 16, 4, 275e12),
-    SliceTopology("v4-64", _V4, "2x4x4", 32, 8, 275e12),
-    SliceTopology("v4-128", _V4, "4x4x4", 64, 16, 275e12),
-    SliceTopology("v4-256", _V4, "4x4x8", 128, 32, 275e12),
-    SliceTopology("v4-512", _V4, "4x8x8", 256, 64, 275e12),
-    SliceTopology("v4-1024", _V4, "8x8x8", 512, 128, 275e12),
-    SliceTopology("v4-2048", _V4, "8x8x16", 1024, 256, 275e12),
-    SliceTopology("v4-4096", _V4, "8x16x16", 2048, 512, 275e12),
-    SliceTopology("v4-6144", _V4, "16x16x12", 3072, 768, 275e12),
+    SliceTopology("v4-8", _V4, "2x2x1", 4, 1, 275e12, 32.0),
+    SliceTopology("v4-16", _V4, "2x2x2", 8, 2, 275e12, 32.0),
+    SliceTopology("v4-32", _V4, "2x2x4", 16, 4, 275e12, 32.0),
+    SliceTopology("v4-64", _V4, "2x4x4", 32, 8, 275e12, 32.0),
+    SliceTopology("v4-128", _V4, "4x4x4", 64, 16, 275e12, 32.0),
+    SliceTopology("v4-256", _V4, "4x4x8", 128, 32, 275e12, 32.0),
+    SliceTopology("v4-512", _V4, "4x8x8", 256, 64, 275e12, 32.0),
+    SliceTopology("v4-1024", _V4, "8x8x8", 512, 128, 275e12, 32.0),
+    SliceTopology("v4-2048", _V4, "8x8x16", 1024, 256, 275e12, 32.0),
+    SliceTopology("v4-4096", _V4, "8x16x16", 2048, 512, 275e12, 32.0),
+    SliceTopology("v4-6144", _V4, "16x16x12", 3072, 768, 275e12, 32.0),
     # v6e (Trillium): 1 TensorCore/chip, 4-chip hosts (8 for -8),
     # 2D topologies up to the 256-chip pod
-    SliceTopology("v6e-1", _V6E, "1x1", 1, 1, 918e12),
-    SliceTopology("v6e-4", _V6E, "2x2", 4, 1, 918e12),
-    SliceTopology("v6e-8", _V6E, "2x4", 8, 1, 918e12),
-    SliceTopology("v6e-16", _V6E, "4x4", 16, 4, 918e12),
-    SliceTopology("v6e-32", _V6E, "4x8", 32, 8, 918e12),
-    SliceTopology("v6e-64", _V6E, "8x8", 64, 16, 918e12),
-    SliceTopology("v6e-128", _V6E, "8x16", 128, 32, 918e12),
-    SliceTopology("v6e-256", _V6E, "16x16", 256, 64, 918e12),
+    SliceTopology("v6e-1", _V6E, "1x1", 1, 1, 918e12, 32.0),
+    SliceTopology("v6e-4", _V6E, "2x2", 4, 1, 918e12, 32.0),
+    SliceTopology("v6e-8", _V6E, "2x4", 8, 1, 918e12, 32.0),
+    SliceTopology("v6e-16", _V6E, "4x4", 16, 4, 918e12, 32.0),
+    SliceTopology("v6e-32", _V6E, "4x8", 32, 8, 918e12, 32.0),
+    SliceTopology("v6e-64", _V6E, "8x8", 64, 16, 918e12, 32.0),
+    SliceTopology("v6e-128", _V6E, "8x16", 128, 32, 918e12, 32.0),
+    SliceTopology("v6e-256", _V6E, "16x16", 256, 64, 918e12, 32.0),
 ]
 
 TOPOLOGIES: dict[str, SliceTopology] = {

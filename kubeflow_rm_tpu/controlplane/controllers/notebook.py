@@ -33,6 +33,9 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     name_of,
 )
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer, NotFound
+from kubeflow_rm_tpu.controlplane.webhook.admission_pricer import (
+    is_admission_rejected,
+)
 from kubeflow_rm_tpu.controlplane.runtime import (
     Controller,
     Request,
@@ -94,8 +97,13 @@ class NotebookController(Controller):
         with self._observe("render"):
             topo = nb_api.tpu_spec(notebook)
             parked, deferring = self._parked_state(api, notebook)
-            sts = self._generate_statefulset(notebook, topo,
-                                             parked=parked or deferring)
+            # predictive admission: a priced-rejected declaration never
+            # renders pods — the OOM is refused BEFORE placement; the
+            # webhook's status.admission carries the explanation and
+            # the advisor rung that would lift the gate
+            rejected = is_admission_rejected(notebook)
+            sts = self._generate_statefulset(
+                notebook, topo, parked=parked or deferring or rejected)
             children = [(sts, copy_statefulset_fields)]
             replicas = nb_api.replicas_of(notebook)
             if replicas > 1:
@@ -213,6 +221,24 @@ class NotebookController(Controller):
             # the node pool's nominal topology, which is what lets the
             # scheduler bin-pack small kernels and the compaction
             # migrator defragment them
+
+            # priced admission: fan the slice's predicted HBM/FLOPs
+            # onto every host pod as its per-pod share — the scheduler
+            # packs on these beside the chip count
+            pred_hbm = ann.get(tpu_api.PREDICTED_HBM_ANNOTATION)
+            if pred_hbm:
+                try:
+                    pod_annotations[tpu_api.PREDICTED_HBM_ANNOTATION] = \
+                        f"{float(pred_hbm) / topo.hosts:.4f}"
+                except (TypeError, ValueError):
+                    pass
+            pred_flops = ann.get(tpu_api.PREDICTED_FLOPS_ANNOTATION)
+            if pred_flops:
+                try:
+                    pod_annotations[tpu_api.PREDICTED_FLOPS_ANNOTATION] = \
+                        f"{float(pred_flops) / topo.hosts:.6g}"
+                except (TypeError, ValueError):
+                    pass
 
         sts_annotations: dict = {}
         if nb_api.MIGRATE_EXCLUDE_ANNOTATION in ann:
@@ -431,6 +457,12 @@ class NotebookController(Controller):
                 for c in deep_get(pod0, "status", "conditions",
                                   default=[]) or []
             ]
+        # status.admission is webhook-owned: carry it through the
+        # mirror or the replace-style status write would wipe it, the
+        # webhook would re-stamp it, and the reconcile never quiesces
+        adm = deep_get(notebook, "status", "admission")
+        if adm is not None:
+            status["admission"] = adm
         if deep_get(notebook, "status") != status:
             prev_ready = deep_get(notebook, "status", "readyReplicas",
                                   default=0)

@@ -65,11 +65,15 @@ def make_tpu_node(name: str, accelerator_type: str) -> dict:
         "status": {
             "capacity": {
                 GOOGLE_TPU_RESOURCE: str(topo.chips_per_host),
+                tpu_api.GOOGLE_TPU_HBM_RESOURCE:
+                    str(topo.hbm_gib_per_host),
                 "cpu": "96",
                 "memory": "384Gi",
             },
             "allocatable": {
                 GOOGLE_TPU_RESOURCE: str(topo.chips_per_host),
+                tpu_api.GOOGLE_TPU_HBM_RESOURCE:
+                    str(topo.hbm_gib_per_host),
                 "cpu": "96",
                 "memory": "384Gi",
             },

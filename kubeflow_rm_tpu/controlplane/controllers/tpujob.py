@@ -37,6 +37,10 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     name_of,
 )
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer, NotFound
+from kubeflow_rm_tpu.controlplane.webhook.admission_pricer import (
+    is_admission_rejected,
+    slice_topology_of,
+)
 from kubeflow_rm_tpu.controlplane.runtime import (
     Controller,
     Request,
@@ -96,7 +100,10 @@ class TPUJobController(Controller):
         sts_name = tj_api.role_sts_name(job_name, role["name"])
         acc = tj_api.role_accelerator(role)
         pods = tj_api.role_pods(role)
-        parked = tj_api.is_stopped(job) or tj_api.is_suspended(job)
+        # priced admission: a rejected declaration parks the WHOLE gang
+        # — no pod of any role renders until the declaration reprices
+        parked = (tj_api.is_stopped(job) or tj_api.is_suspended(job)
+                  or is_admission_rejected(job))
 
         template = fast_deepcopy(role.get("template") or {})
         pod_spec = template.get("spec") or {}
@@ -132,6 +139,28 @@ class TPUJobController(Controller):
             sel = pod_spec.setdefault("nodeSelector", {})
             sel[tpu_api.NODE_LABEL_ACCELERATOR] = topo.gke_accelerator
             sel[tpu_api.NODE_LABEL_TOPOLOGY] = topo.topology
+            # priced admission: the declared workload lives on the
+            # learner slice — fan its predicted HBM/FLOPs per-pod onto
+            # that role only (CPU actors carry no HBM charge)
+            priced_topo = slice_topology_of(job)
+            if priced_topo and acc == priced_topo.accelerator_type:
+                job_ann = annotations_of(job)
+                pred = job_ann.get(tpu_api.PREDICTED_HBM_ANNOTATION)
+                if pred:
+                    try:
+                        pod_annotations[
+                            tpu_api.PREDICTED_HBM_ANNOTATION] = \
+                            f"{float(pred) / topo.hosts:.4f}"
+                    except (TypeError, ValueError):
+                        pass
+                pred = job_ann.get(tpu_api.PREDICTED_FLOPS_ANNOTATION)
+                if pred:
+                    try:
+                        pod_annotations[
+                            tpu_api.PREDICTED_FLOPS_ANNOTATION] = \
+                            f"{float(pred) / topo.hosts:.6g}"
+                    except (TypeError, ValueError):
+                        pass
         cpu = role.get("cpu")
         if cpu is not None:
             requests = containers[0].setdefault("resources", {}) \
@@ -279,6 +308,12 @@ class TPUJobController(Controller):
         phase = self._phase(ann, gang_pods, ready, total)
         status = {"phase": phase, "readyPods": ready,
                   "totalPods": total, "roles": role_status}
+        # status.admission is webhook-owned: carry it through the
+        # mirror so the replace-style status write doesn't wipe it
+        # (the webhook would re-stamp it and reconcile never quiesces)
+        adm = deep_get(job, "status", "admission")
+        if adm is not None:
+            status["admission"] = adm
         prev_phase = deep_get(job, "status", "phase")
         if deep_get(job, "status") != status:
             job["status"] = status

@@ -259,6 +259,14 @@ SCHEDULER_FRAGMENTATION = Gauge(
     "(0 = all free capacity gang-placeable, 1 = fully stranded)",
     registry=REGISTRY,
 )
+SCHEDULER_FREE_HBM_GIB = Gauge(
+    "scheduler_free_hbm_gib",
+    "Unclaimed predicted-HBM (GiB) across the tracked node fleet — the "
+    "second gang-packing axis under --hbm-packing; unlike chips this "
+    "axis is never overcommitted, so free approaching 0 is the true "
+    "admission ceiling for declared workloads",
+    registry=REGISTRY,
+)
 NOTEBOOK_SUSPEND_TOTAL = Counter(
     "notebook_suspend_total",
     "Notebooks driven to Suspended, by reason (idle | preempted | api)",
@@ -590,6 +598,27 @@ NOTEBOOK_MIGRATION_TOTAL = Counter(
     "Live migrations (checkpoint -> drain -> re-bind on different "
     "nodes) by trigger (api | fragmentation)",
     ["trigger"],
+    registry=REGISTRY,
+)
+
+# ---- compute-path fleet SLIs (jaxcheck probes, per tenant) -----------
+JIT_RECOMPILES_TOTAL = Counter(
+    "jit_recompiles",
+    "New (shape, dtype, static-arg) signatures observed by the "
+    "jaxcheck recompile sentinel, per tenant — a sustained rate means "
+    "some notebook is feeding dynamic shapes into jit and burning its "
+    "slice on XLA compiles instead of steps (feeds the "
+    "recompile-storm RateSLO)",
+    ["tenant"],
+    registry=REGISTRY,
+)
+IMPLICIT_HOSTSYNCS_TOTAL = Counter(
+    "implicit_hostsyncs",
+    "Implicit device->host transfers (bool()/.item()/np.asarray on "
+    "device arrays) witnessed by the jaxcheck hostsync probe inside "
+    "instrumented regions, per tenant — each one stalls the TPU "
+    "pipeline for a host round-trip (feeds the hostsync-storm RateSLO)",
+    ["tenant"],
     registry=REGISTRY,
 )
 

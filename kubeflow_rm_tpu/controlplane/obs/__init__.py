@@ -26,14 +26,16 @@ import time
 
 from .flight import FlightRecorder
 from .runmeta import build_run_meta, compatible
-from .slo import (GaugeSLO, LatencySLO, RateSLO, SLO, SLOEngine, Window,
-                  default_slos)
+from .slo import (GaugeSLO, LatencySLO, RateSLO, SLO, SLOEngine,
+                  TenantRateSLO, Window, default_slos,
+                  install_probe_bridges)
 from .timeseries import TimeSeriesDB, parse_exposition
 
 __all__ = [
     "FlightRecorder", "GaugeSLO", "LatencySLO", "Observer", "RateSLO",
-    "SLO", "SLOEngine", "TimeSeriesDB", "Window", "build_run_meta",
-    "compatible", "default_slos", "parse_exposition",
+    "SLO", "SLOEngine", "TenantRateSLO", "TimeSeriesDB", "Window",
+    "build_run_meta", "compatible", "default_slos",
+    "install_probe_bridges", "parse_exposition",
 ]
 
 
@@ -67,6 +69,9 @@ class Observer:
             self.tsdb.add_scrape(name, url)
         self.engine = SLOEngine(
             self.tsdb, default_slos() if slos is None else slos)
+        # the per-tenant jaxcheck SLOs only burn if the probes feed
+        # the counters — hang the bridge the moment an Observer exists
+        install_probe_bridges()
         self.flight = FlightRecorder(
             self.tsdb, window_s=flight_window_s, liveness=liveness,
             shard_urls=shard_urls, run_meta=run_meta)

@@ -111,6 +111,39 @@ class RateSLO(SLO):
 
 
 @dataclass
+class TenantRateSLO(RateSLO):
+    """Per-tenant counter family: the SLO burns at the rate of the
+    WORST tenant, not the fleet sum — one notebook retracing its jit
+    cache every step must page even while a hundred quiet tenants
+    dilute the aggregate. The offending tenant is surfaced in
+    ``spec()`` so ``/api/alerts`` names the noisy neighbour."""
+    label_key: str = "tenant"
+    worst_tenant: str | None = None
+
+    def burn_rate(self, tsdb, window_s, now=None):
+        worst = None
+        offender = None
+        for tenant in tsdb.label_values(self.metric, self.label_key):
+            rate = tsdb.rate(
+                self.metric,
+                dict(self.labels or {}, **{self.label_key: tenant}),
+                window_s, now=now)
+            if rate is None:
+                continue
+            if worst is None or rate > worst:
+                worst, offender = rate, tenant
+        if worst is None:
+            return None
+        self.worst_tenant = offender
+        return worst / max(1e-12, self.allowed_per_s)
+
+    def spec(self):
+        d = super().spec()
+        d.update(label_key=self.label_key, worst_tenant=self.worst_tenant)
+        return d
+
+
+@dataclass
 class GaugeSLO(SLO):
     """Gauge whose *windowed mean* must stay under ``threshold`` —
     sustained elevation burns, transient spikes do not."""
@@ -191,6 +224,21 @@ def default_slos() -> list[SLO]:
                         "or traffic lost all prefix overlap) — decode "
                         "replicas are back to paying full prefill "
                         "after every rebalance or death"),
+        TenantRateSLO(
+            name="jit-recompile-storm", metric="jit_recompiles_total",
+            windows=warn_only, allowed_per_s=1.0 / 30.0,
+            description="a tenant minting new jit signatures faster "
+                        "than ~2/min is retracing in a hot loop — its "
+                        "slice burns XLA compiles instead of steps "
+                        "(jaxcheck recompile sentinel, per-tenant)"),
+        TenantRateSLO(
+            name="implicit-hostsync-storm",
+            metric="implicit_hostsyncs_total",
+            windows=warn_only, allowed_per_s=1.0 / 30.0,
+            description="a tenant tripping unsanctioned device->host "
+                        "syncs inside declared hot regions serializes "
+                        "its TPU behind Python round-trips (jaxcheck "
+                        "hostsync probe, per-tenant)"),
         RateSLO(
             name="shard-deaths", metric="shard_deaths_total",
             windows=(Window(120.0, 15.0, 1.0, "critical"),),
@@ -199,6 +247,45 @@ def default_slos() -> list[SLO]:
                         "pages; the watchdog respawns, the alert "
                         "captures that it had to"),
     ]
+
+
+# -- jaxcheck probe -> per-tenant fleet counters ----------------------
+
+def tenant_of(name: str) -> str:
+    """Tenant from a probe entry/region name: the convention is
+    ``<tenant>/<site>`` (``teamA/decode-step``); unprefixed names fold
+    into ``default``."""
+    tenant, sep, _ = name.partition("/")
+    return tenant if sep and tenant else "default"
+
+
+_bridges_installed = False
+
+
+def install_probe_bridges() -> None:
+    """Wire the jaxcheck recompile sentinel and hostsync probe into
+    the per-tenant ``jit_recompiles_total`` /
+    ``implicit_hostsyncs_total`` counters, which the TSDB samples and
+    the :class:`TenantRateSLO` pair above burns against. Idempotent;
+    the probes stay importable (and free) without the control plane —
+    this is the only coupling point, and it is one-directional."""
+    global _bridges_installed
+    if _bridges_installed:
+        return
+    from kubeflow_rm_tpu.analysis.jaxcheck import hostsync, recompile
+    from kubeflow_rm_tpu.controlplane import metrics
+
+    def _on_recompile(entry: str, n_signatures: int) -> None:
+        metrics.JIT_RECOMPILES_TOTAL.labels(
+            tenant=tenant_of(entry)).inc()
+
+    def _on_hostsync(region: str, kind: str) -> None:
+        metrics.IMPLICIT_HOSTSYNCS_TOTAL.labels(
+            tenant=tenant_of(region)).inc()
+
+    recompile.add_observer(_on_recompile)
+    hostsync.add_observer(_on_hostsync)
+    _bridges_installed = True
 
 
 @dataclass

@@ -420,6 +420,14 @@ class TimeSeriesDB:
         bad = max(0.0, total - good)
         return (bad / total, total)
 
+    def label_values(self, name: str, key: str) -> list[str]:
+        """Distinct values of label ``key`` across series of family
+        ``name`` — how per-tenant SLOs enumerate the tenants the
+        counters have actually seen (no tenant registry needed)."""
+        with self._lock:
+            return sorted({s.labels[key] for s in self._series.values()
+                           if s.name == name and key in s.labels})
+
     # ---- introspection / dump ---------------------------------------
 
     def series_names(self) -> list[str]:

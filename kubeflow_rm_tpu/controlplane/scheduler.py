@@ -49,7 +49,12 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
     namespace_of,
     parse_quantity,
 )
-from kubeflow_rm_tpu.controlplane.api.tpu import GOOGLE_TPU_RESOURCE
+from kubeflow_rm_tpu.controlplane.api.tpu import (
+    GOOGLE_TPU_HBM_RESOURCE,
+    GOOGLE_TPU_RESOURCE,
+    PREDICTED_FLOPS_ANNOTATION,
+    PREDICTED_HBM_ANNOTATION,
+)
 from kubeflow_rm_tpu.analysis.lockgraph import make_lock
 
 #: phases whose pods no longer occupy their node's chips (a kubelet
@@ -69,6 +74,33 @@ _ASSUMED = float("inf")
 #: the second tracked resource (mixed-resource gangs): CPU cores,
 #: parsed with millicore support ("500m" → 0.5)
 CPU_RESOURCE = "cpu"
+
+#: bounded chip overcommit under ``--hbm-packing``: a pod that DECLARED
+#: its workload (so the jaxcheck walker priced its HBM) may share a
+#: node's chips up to this multiple of the physical chip count — the
+#: HBM axis, which is what actually OOMs, is never overcommitted.
+#: Undeclared chip pods stay strictly chip-bounded AND charge their
+#: full per-chip HBM share, so the two populations can't starve each
+#: other invisibly.
+CHIP_OVERCOMMIT = 4.0
+
+#: float-sum slack on the HBM axis (GiB): 64 pods × a 4-decimal
+#: annotation round each way stays far under this
+_HBM_EPS = 1e-4
+
+_hbm_packing = False
+
+
+def set_hbm_packing(enabled: bool) -> None:
+    """Enable predicted-HBM as the second gang-packing axis (the
+    ``--hbm-packing`` conformance arm). Off (default) = chip-count-only
+    admission, the A/B baseline."""
+    global _hbm_packing
+    _hbm_packing = bool(enabled)
+
+
+def hbm_packing() -> bool:
+    return _hbm_packing
 
 
 def _pod_resource(pod: dict, resource: str) -> float:
@@ -93,6 +125,44 @@ def _pod_cpu(pod: dict) -> float:
     return _pod_resource(pod, CPU_RESOURCE)
 
 
+def _pod_declared_hbm_gib(pod: dict) -> float | None:
+    """The webhook-priced per-pod HBM share (decimal GB annotation →
+    GiB), or None when the pod carries no declaration."""
+    raw = deep_get(pod, "metadata", "annotations",
+                   PREDICTED_HBM_ANNOTATION)
+    if raw is None:
+        return None
+    try:
+        gb = float(raw)
+    except (TypeError, ValueError):
+        return None
+    if gb < 0:
+        return None
+    return gb * 1e9 / 2**30
+
+
+def _pod_flops(pod: dict) -> float:
+    """Predicted FLOPs/step (the packing tiebreak); 0 when undeclared."""
+    raw = deep_get(pod, "metadata", "annotations",
+                   PREDICTED_FLOPS_ANNOTATION)
+    try:
+        return max(0.0, float(raw))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _hbm_charge(declared: float | None, chips: float,
+                hbm_capacity: float, capacity: float) -> float:
+    """What a pod charges on a node's HBM axis: its declared share, or
+    — undeclared chip pod — the full per-chip HBM slice of that node
+    (it may use every byte, so it must be accounted as if it will)."""
+    if declared is not None:
+        return declared
+    if chips > 0 and capacity > 0 and hbm_capacity > 0:
+        return chips * hbm_capacity / capacity
+    return 0.0
+
+
 class _Node:
     """One node's slice of the usage map — both resources under one
     lock so a mixed bind is atomic per node. ``used``/``cpu_used`` are
@@ -100,28 +170,35 @@ class _Node:
     never contend."""
 
     __slots__ = ("name", "labels", "capacity", "used",
-                 "cpu_capacity", "cpu_used", "lock")
+                 "cpu_capacity", "cpu_used",
+                 "hbm_capacity", "hbm_used", "flops_used", "lock")
 
     def __init__(self, name: str, labels: dict, capacity: float,
-                 cpu_capacity: float = 0.0):
+                 cpu_capacity: float = 0.0, hbm_capacity: float = 0.0):
         self.name = name
         self.labels = labels
         self.capacity = capacity        # chips
         self.used = 0.0                 # chips
         self.cpu_capacity = cpu_capacity
         self.cpu_used = 0.0
+        self.hbm_capacity = hbm_capacity   # GiB, never overcommitted
+        self.hbm_used = 0.0
+        self.flops_used = 0.0           # predicted FLOPs/step (tiebreak)
         # one ranked family: _commit acquires gang members sorted by
         # node name, which is exactly the rank the analyser verifies
         self.lock = make_lock("scheduler.node", rank=name)
 
 
 class _Entry:
-    __slots__ = ("node", "chips", "cpu", "rv")
+    __slots__ = ("node", "chips", "cpu", "hbm", "flops", "rv")
 
-    def __init__(self, node: str, chips: float, cpu: float, rv: float):
+    def __init__(self, node: str, chips: float, cpu: float, rv: float,
+                 hbm: float = 0.0, flops: float = 0.0):
         self.node = node
         self.chips = chips
         self.cpu = cpu
+        self.hbm = hbm                  # GiB actually charged
+        self.flops = flops
         self.rv = rv
 
 
@@ -175,14 +252,18 @@ class SchedulerCache:
                 default=0))
             cpu_cap = parse_quantity(deep_get(
                 obj, "status", "allocatable", CPU_RESOURCE, default=0))
+            hbm_cap = parse_quantity(deep_get(
+                obj, "status", "allocatable", GOOGLE_TPU_HBM_RESOURCE,
+                default=0))
             if node is None:
                 self._nodes[name] = _Node(name, labels_of(obj), cap,
-                                          cpu_cap)
+                                          cpu_cap, hbm_cap)
             else:
                 # keep the object (its lock + used survive relabels)
                 node.labels = labels_of(obj)
                 node.capacity = cap
                 node.cpu_capacity = cpu_cap
+                node.hbm_capacity = hbm_cap
 
     def _apply_pod(self, etype: str, obj: dict) -> None:
         from kubeflow_rm_tpu.controlplane import metrics
@@ -197,6 +278,17 @@ class SchedulerCache:
         node_name = None if gone else deep_get(obj, "spec", "nodeName")
         chips = _pod_chips(obj)
         cpu = _pod_cpu(obj)
+        # the HBM charge depends on the landing node's shape (an
+        # undeclared pod charges that node's per-chip share), so it is
+        # resolved here — BEFORE _plock, respecting the _nlock order
+        hbm = flops = 0.0
+        if node_name:
+            with self._nlock:
+                node = self._nodes.get(node_name)
+            if node is not None:
+                hbm = _hbm_charge(_pod_declared_hbm_gib(obj), chips,
+                                  node.hbm_capacity, node.capacity)
+            flops = _pod_flops(obj)
         with self._plock:
             cur = self._pods.get(key)
             if cur is not None and rv < cur.rv:
@@ -204,25 +296,27 @@ class SchedulerCache:
                 # already charged this pod at a later version — applying
                 # the older view would free chips that are still held
                 return
-            dec = (cur.node, cur.chips, cur.cpu) if cur is not None \
-                else None
+            dec = (cur.node, cur.chips, cur.cpu, cur.hbm, cur.flops) \
+                if cur is not None else None
             if node_name:
-                self._pods[key] = _Entry(node_name, chips, cpu, rv)
-                inc = (node_name, chips, cpu)
+                self._pods[key] = _Entry(node_name, chips, cpu, rv,
+                                         hbm, flops)
+                inc = (node_name, chips, cpu, hbm, flops)
             else:
                 self._pods.pop(key, None)
                 inc = None
         self._adjust(dec, inc)
 
-    def _adjust(self, dec: tuple[str, float, float] | None,
-                inc: tuple[str, float, float] | None) -> None:
+    def _adjust(self, dec: tuple[str, float, float, float, float] | None,
+                inc: tuple[str, float, float, float, float] | None
+                ) -> None:
         if dec == inc:
             return
         for charge, delta in ((dec, -1), (inc, +1)):
             if charge is None:
                 continue
-            name, chips, cpu = charge
-            if not chips and not cpu:
+            name, chips, cpu, hbm, flops = charge
+            if not chips and not cpu and not hbm:
                 continue
             with self._nlock:
                 node = self._nodes.get(name)
@@ -231,6 +325,9 @@ class SchedulerCache:
             with node.lock:
                 node.used = max(0.0, node.used + delta * chips)
                 node.cpu_used = max(0.0, node.cpu_used + delta * cpu)
+                node.hbm_used = max(0.0, node.hbm_used + delta * hbm)
+                node.flops_used = max(0.0,
+                                      node.flops_used + delta * flops)
 
     # -- snapshot rebuild (prime + TOO_OLD recovery) -------------------
     def rebuild(self, api) -> None:
@@ -253,14 +350,18 @@ class SchedulerCache:
                     cpu_cap = parse_quantity(deep_get(
                         n, "status", "allocatable", CPU_RESOURCE,
                         default=0))
+                    hbm_cap = parse_quantity(deep_get(
+                        n, "status", "allocatable",
+                        GOOGLE_TPU_HBM_RESOURCE, default=0))
                     node = self._nodes.get(name)
                     if node is None:
                         self._nodes[name] = _Node(name, labels_of(n),
-                                                  cap, cpu_cap)
+                                                  cap, cpu_cap, hbm_cap)
                     else:
                         node.labels = labels_of(n)
                         node.capacity = cap
                         node.cpu_capacity = cpu_cap
+                        node.hbm_capacity = hbm_cap
                 for name in list(self._nodes):
                     if name not in seen:
                         del self._nodes[name]
@@ -279,20 +380,32 @@ class SchedulerCache:
                             "resourceVersion") or 0)
                     except (TypeError, ValueError):
                         rv = 0.0
-                    fresh[key] = _Entry(node_name, _pod_chips(p),
-                                        _pod_cpu(p), rv)
+                    chips = _pod_chips(p)
+                    lnode = live_nodes.get(node_name)
+                    hbm = _hbm_charge(
+                        _pod_declared_hbm_gib(p), chips,
+                        lnode.hbm_capacity if lnode else 0.0,
+                        lnode.capacity if lnode else 0.0)
+                    fresh[key] = _Entry(node_name, chips,
+                                        _pod_cpu(p), rv, hbm,
+                                        _pod_flops(p))
                 for key, e in self._pods.items():
                     if e.rv is _ASSUMED and key not in fresh:
                         fresh[key] = e
                 self._pods = fresh
-                per_node: dict[str, tuple[float, float]] = {}
+                per_node: dict[str, list[float]] = {}
                 for e in fresh.values():
-                    chips, cpu = per_node.get(e.node, (0.0, 0.0))
-                    per_node[e.node] = (chips + e.chips, cpu + e.cpu)
+                    acc = per_node.setdefault(
+                        e.node, [0.0, 0.0, 0.0, 0.0])
+                    acc[0] += e.chips
+                    acc[1] += e.cpu
+                    acc[2] += e.hbm
+                    acc[3] += e.flops
             for node in live_nodes.values():
                 with node.lock:
-                    node.used, node.cpu_used = per_node.get(
-                        node.name, (0.0, 0.0))
+                    (node.used, node.cpu_used, node.hbm_used,
+                     node.flops_used) = per_node.get(
+                        node.name, (0.0, 0.0, 0.0, 0.0))
         metrics.SCHEDULER_CACHE_REBUILDS_TOTAL.inc()
 
     def _ensure_fresh(self) -> None:
@@ -346,40 +459,72 @@ class SchedulerCache:
             # snapshotted once per attempt; name breaks ties so plans
             # are deterministic.
             free0: dict[str, float] = {}
+            flops0: dict[str, float] = {}
             for node in nodes:
                 with node.lock:
                     free0[node.name] = node.capacity - node.used
-            nodes.sort(key=lambda n: (free0[n.name], n.name))
+                    flops0[node.name] = node.flops_used
+            # predicted FLOPs/step is the SECOND sort key: among
+            # equally-fragmented nodes, land on the computationally
+            # coolest one — declared heavy trainers spread out instead
+            # of stacking behind one oversubscribed systolic array
+            nodes.sort(key=lambda n: (free0[n.name], flops0[n.name],
+                                      n.name))
             plan: dict[tuple, str] = {}
-            # per-node tentative (chips, cpu) charged by THIS gang —
-            # heterogeneous pods share the map so a learner host and an
-            # actor landing on the same node both count
-            tentative: dict[str, tuple[float, float]] = {}
+            # per-node tentative [chips, cpu, hbm, relaxed] charged by
+            # THIS gang — heterogeneous pods share the map so a learner
+            # host and an actor landing on the same node both count;
+            # ``relaxed`` records that a declared-HBM pod was admitted
+            # past the physical chip count (hbm-packing overcommit)
+            tentative: dict[str, list] = {}
+            packing = hbm_packing()
             for pod in sorted(pods, key=name_of):
                 key = (namespace_of(pod), name_of(pod))
                 selector = deep_get(pod, "spec", "nodeSelector",
                                     default={}) or {}
                 need = _pod_chips(pod)
                 need_cpu = _pod_cpu(pod)
+                declared = _pod_declared_hbm_gib(pod)
                 chosen = None
+                chosen_hbm = 0.0
+                relax = False
                 for node in nodes:
                     if exclude_nodes and node.name in exclude_nodes:
                         continue
                     if selector and not matches_selector(
                             node.labels, {"matchLabels": selector}):
                         continue
+                    need_hbm = _hbm_charge(declared, need,
+                                           node.hbm_capacity,
+                                           node.capacity)
                     if need or need_cpu:
                         with node.lock:
                             used, cpu_used = node.used, node.cpu_used
-                        t_chips, t_cpu = tentative.get(
-                            node.name, (0.0, 0.0))
-                        if need and (used + t_chips + need
-                                     > node.capacity):
+                            hbm_used = node.hbm_used
+                        t = tentative.get(node.name)
+                        t_chips, t_cpu, t_hbm = (
+                            (t[0], t[1], t[2]) if t else
+                            (0.0, 0.0, 0.0))
+                        # a priced pod on a priced node may pack past
+                        # the chip count (bounded) — the HBM check
+                        # below is then the real admission gate
+                        relax = (packing and declared is not None
+                                 and node.hbm_capacity > 0)
+                        limit = node.capacity * (
+                            CHIP_OVERCOMMIT if relax else 1.0)
+                        if need and (used + t_chips + need > limit):
                             continue
                         if need_cpu and (cpu_used + t_cpu + need_cpu
                                          > node.cpu_capacity):
                             continue
+                        # the HBM axis is NEVER overcommitted — this
+                        # is what makes the chip relaxation safe
+                        if need_hbm and node.hbm_capacity > 0 and (
+                                hbm_used + t_hbm + need_hbm
+                                > node.hbm_capacity + _HBM_EPS):
+                            continue
                     chosen = node.name
+                    chosen_hbm = need_hbm if (need or need_cpu) else 0.0
                     break
                 if chosen is None:
                     if allow_virtual and not selector and not need \
@@ -389,19 +534,23 @@ class SchedulerCache:
                     return None  # gang is all-or-nothing
                 plan[key] = chosen
                 if need or need_cpu:
-                    t_chips, t_cpu = tentative.get(chosen, (0.0, 0.0))
-                    tentative[chosen] = (t_chips + need,
-                                         t_cpu + need_cpu)
+                    t = tentative.setdefault(
+                        chosen, [0.0, 0.0, 0.0, False, 0.0])
+                    t[0] += need
+                    t[1] += need_cpu
+                    t[2] += chosen_hbm
+                    t[3] = t[3] or relax
+                    t[4] += _pod_flops(pod)
             if self._commit(pods, plan, tentative):
                 return plan
         return None
 
     def _commit(self, pods: list[dict], plan: dict[tuple, str],
-                tentative: dict[str, tuple[float, float]]) -> bool:
-        """Re-verify BOTH resources and charge the gang under its
+                tentative: dict[str, list]) -> bool:
+        """Re-verify EVERY axis and charge the gang under its
         nodes' locks (sorted acquisition — deadlock-free against
         sibling gangs), then record the assumed entries. Verification
-        failure on either axis rejects the whole gang with nothing
+        failure on any axis rejects the whole gang with nothing
         charged."""
         with self._nlock:
             locked = [self._nodes[n] for n in sorted(tentative)
@@ -413,20 +562,32 @@ class SchedulerCache:
                 node.lock.acquire()
             try:
                 for node in locked:
-                    t_chips, t_cpu = tentative[node.name]
-                    if node.used + t_chips > node.capacity:
+                    (t_chips, t_cpu, t_hbm, relax,
+                     _t_flops) = tentative[node.name]
+                    limit = node.capacity * (
+                        CHIP_OVERCOMMIT if relax else 1.0)
+                    if node.used + t_chips > limit:
                         return False
                     if node.cpu_used + t_cpu > node.cpu_capacity:
                         return False
+                    if t_hbm and node.hbm_capacity > 0 and (
+                            node.hbm_used + t_hbm
+                            > node.hbm_capacity + _HBM_EPS):
+                        return False
                 for node in locked:
-                    t_chips, t_cpu = tentative[node.name]
+                    (t_chips, t_cpu, t_hbm, _,
+                     t_flops) = tentative[node.name]
                     node.used += t_chips
                     node.cpu_used += t_cpu
+                    node.hbm_used += t_hbm
+                    node.flops_used += t_flops
             finally:
                 for node in locked:
                     node.lock.release()
             from kubeflow_rm_tpu.controlplane import metrics
-            stale: list[tuple[str, float, float]] = []
+            stale: list[tuple[str, float, float, float, float]] = []
+            node_shapes = {n.name: (n.hbm_capacity, n.capacity)
+                           for n in locked}
             with self._plock:
                 for pod in pods:
                     key = (namespace_of(pod), name_of(pod))
@@ -437,10 +598,17 @@ class SchedulerCache:
                         # charge so the gang's doesn't double-count
                         if cur.rv is _ASSUMED:
                             self._assumed -= 1
-                        stale.append((cur.node, cur.chips, cur.cpu))
+                        stale.append((cur.node, cur.chips, cur.cpu,
+                                      cur.hbm, cur.flops))
+                    chips = _pod_chips(pod)
+                    hbm_cap, cap = node_shapes.get(plan[key],
+                                                   (0.0, 0.0))
+                    hbm = _hbm_charge(_pod_declared_hbm_gib(pod),
+                                      chips, hbm_cap, cap)
+                    flops = _pod_flops(pod)
                     self._pods[key] = _Entry(
-                        plan[key], _pod_chips(pod), _pod_cpu(pod),
-                        _ASSUMED)
+                        plan[key], chips, _pod_cpu(pod),
+                        _ASSUMED, hbm, flops)
                     self._assumed += 1
                 metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
             for dec in stale:
@@ -472,7 +640,7 @@ class SchedulerCache:
             del self._pods[key]
             self._assumed -= 1
             metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
-        self._adjust((e.node, e.chips, e.cpu), None)
+        self._adjust((e.node, e.chips, e.cpu, e.hbm, e.flops), None)
 
     def release(self, key: tuple) -> None:
         """Out-of-band eviction for suspend/preemption teardown: the
@@ -491,7 +659,7 @@ class SchedulerCache:
             if e.rv is _ASSUMED:
                 self._assumed -= 1
                 metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
-        self._adjust((e.node, e.chips, e.cpu), None)
+        self._adjust((e.node, e.chips, e.cpu, e.hbm, e.flops), None)
 
     # -- read-side helpers ---------------------------------------------
     def total_used(self) -> float:
@@ -533,6 +701,18 @@ class SchedulerCache:
             out[node.name] = (free, node.labels)
         return out
 
+    def hbm_by_node(self) -> dict[str, tuple[float, float]]:
+        """``{node: (hbm_used_gib, hbm_capacity_gib)}`` — the
+        conformance harness's zero-overcommit assertion reads this
+        after every bind wave."""
+        with self._nlock:
+            nodes = list(self._nodes.values())
+        out: dict[str, tuple[float, float]] = {}
+        for node in nodes:
+            with node.lock:
+                out[node.name] = (node.hbm_used, node.hbm_capacity)
+        return out
+
     def stats(self) -> dict:
         """Cache counters plus the bin-packing view: ``free_chips``
         (total unclaimed capacity), ``largest_free_gang`` (the biggest
@@ -549,10 +729,12 @@ class SchedulerCache:
             nodes = list(self._nodes.values())
         free: list[float] = []
         free_cpu = 0.0
+        free_hbm = 0.0
         for node in nodes:
             with node.lock:
                 free.append(max(0.0, node.capacity - node.used))
                 free_cpu += max(0.0, node.cpu_capacity - node.cpu_used)
+                free_hbm += max(0.0, node.hbm_capacity - node.hbm_used)
         free_chips = sum(free)
         largest = 0.0
         for i, f in enumerate(sorted(free, reverse=True)):
@@ -563,9 +745,10 @@ class SchedulerCache:
         metrics.SCHEDULER_FREE_CHIPS.set(free_chips)
         metrics.SCHEDULER_LARGEST_FREE_GANG.set(largest)
         metrics.SCHEDULER_FRAGMENTATION.set(frag)
+        metrics.SCHEDULER_FREE_HBM_GIB.set(free_hbm)
         return {"nodes": len(nodes), "pods": pods, "assumed": assumed,
                 "stale": self._stale, "free_chips": free_chips,
-                "free_cpu": free_cpu,
+                "free_cpu": free_cpu, "free_hbm_gib": free_hbm,
                 "largest_free_gang": largest, "fragmentation": frag}
 
 

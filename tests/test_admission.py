@@ -1,0 +1,321 @@
+"""Predictive admission + the HBM packing axis: the jaxcheck pricer in
+the webhook path (webhook/admission_pricer.py), the controllers'
+rejected-before-placement gate, and the scheduler's predicted-HBM
+second axis (scheduler.py --hbm-packing)."""
+
+import json
+import threading
+
+import pytest
+
+from kubeflow_rm_tpu.controlplane import make_control_plane, scheduler
+from kubeflow_rm_tpu.controlplane.api.meta import deep_get, set_annotation
+from kubeflow_rm_tpu.controlplane.api.notebook import make_notebook
+from kubeflow_rm_tpu.controlplane.api.tpu import (
+    DECLARED_WORKLOAD_ANNOTATION,
+    GOOGLE_TPU_HBM_RESOURCE,
+    GOOGLE_TPU_RESOURCE,
+    PREDICTED_FLOPS_ANNOTATION,
+    PREDICTED_HBM_ANNOTATION,
+)
+from kubeflow_rm_tpu.controlplane.apiserver import APIServer
+from kubeflow_rm_tpu.controlplane.controllers.statefulset import (
+    make_tpu_node,
+)
+from kubeflow_rm_tpu.controlplane.scheduler import SchedulerCache
+
+# a deliberately tiny model whose LOGITS dominate: microbatch 256 at
+# seq 4096 over a 32k vocab is ~134 decimal GB of fp32 logits — over a
+# v5litepod-8's ~135 GB usable budget once the 5% allocator margin
+# applies, so the verdict is "rejected" while the trace itself stays
+# sub-second (2 layers, dim 64)
+TINY_DIMS = {"dim": 64, "n_layers": 2, "n_heads": 4, "n_kv_heads": 4,
+             "hidden_dim": 256, "vocab_size": 32000}
+OOM_DECL = {"model": TINY_DIMS, "seq": 4096, "batch": 256,
+            "grad_accum": 1, "optim": "adamw", "remat": "full",
+            "tenant": "teamA"}
+FIT_DECL = {**OOM_DECL, "grad_accum": 4}
+
+
+@pytest.fixture
+def stack():
+    api, mgr = make_control_plane()
+    api.ensure_namespace("user1")
+    api.create(make_tpu_node("v5e-0", "v5litepod-8"))
+    return api, mgr
+
+
+def spawn(api, mgr, nb):
+    api.create(nb)
+    mgr.run_until_idle()
+    return api.get("Notebook", nb["metadata"]["name"],
+                   nb["metadata"]["namespace"])
+
+
+# ---- the webhook: priced verdicts in status.admission ----------------
+
+def test_oom_declaration_rejected_before_placement(stack):
+    api, mgr = stack
+    nb = spawn(api, mgr, make_notebook(
+        "oom", "user1", accelerator_type="v5litepod-8",
+        annotations={DECLARED_WORKLOAD_ANNOTATION:
+                     json.dumps(OOM_DECL)}))
+    adm = nb["status"]["admission"]
+    assert adm["verdict"] == "rejected"
+    # the priced explanation: predicted vs budget, and which phase binds
+    assert adm["predicted_peak_per_chip_gb"] > adm["budget_per_chip_gb"]
+    assert "exceeds" in adm["explanation"]
+    assert str(adm["budget_per_chip_gb"]) in adm["explanation"]
+    # which phase binds is the explanation's headline
+    assert adm["binds"] in adm["explanation"]
+    assert adm["breakdown_gb"]["logits"] > adm["budget_per_chip_gb"]
+    assert adm["chips"] == 8
+    # rejected BEFORE placement: no pod ever rendered
+    assert api.list("Pod", "user1") == []
+    sts = api.get("StatefulSet", "oom", "user1")
+    assert sts is None or sts["spec"]["replicas"] == 0
+    # and the event says why, with the advisor's paste-back rung
+    evs = [e for e in api.events_for(nb)
+           if e["reason"] == "AdmissionRejected"]
+    assert evs and evs[0]["type"] == "Warning"
+    assert "advisor" in evs[0]["message"]
+
+
+def test_advisor_writes_cheapest_passing_rung(stack):
+    api, mgr = stack
+    nb = spawn(api, mgr, make_notebook(
+        "advice", "user1", accelerator_type="v5litepod-8",
+        annotations={DECLARED_WORKLOAD_ANNOTATION:
+                     json.dumps(OOM_DECL)}))
+    advice = nb["status"]["admission"]["advisor"]
+    assert advice is not None
+    rung = advice["workload"]
+    # the rung shrank the microbatch, not the global batch
+    assert rung["batch"] == OOM_DECL["batch"]
+    assert rung["grad_accum"] > OOM_DECL["grad_accum"]
+    assert advice["predicted_peak_per_chip_gb"] <= \
+        advice["budget_per_chip_gb"]
+    assert "grad_accum" in advice["note"]
+
+    # pasting the rung back admits AND schedules
+    set_annotation(nb, DECLARED_WORKLOAD_ANNOTATION, json.dumps(rung))
+    api.update(nb)
+    mgr.run_until_idle()
+    nb = api.get("Notebook", "advice", "user1")
+    assert nb["status"]["admission"]["verdict"] == "fit"
+    pods = api.list("Pod", "user1")
+    assert len(pods) == 1
+    assert deep_get(pods[0], "status", "phase") == "Running"
+
+
+def test_fit_declaration_stamps_predicted_annotations(stack):
+    api, mgr = stack
+    nb = spawn(api, mgr, make_notebook(
+        "fit", "user1", accelerator_type="v5litepod-8",
+        annotations={DECLARED_WORKLOAD_ANNOTATION:
+                     json.dumps(FIT_DECL)}))
+    adm = nb["status"]["admission"]
+    assert adm["verdict"] == "fit"
+    ann = nb["metadata"]["annotations"]
+    assert float(ann[PREDICTED_HBM_ANNOTATION]) == \
+        adm["predicted_peak_gb"]
+    assert float(ann[PREDICTED_FLOPS_ANNOTATION]) > 0
+    # the controller fans the slice totals out per pod (1 host here)
+    pod = api.list("Pod", "user1")[0]
+    pod_ann = pod["metadata"]["annotations"]
+    assert float(pod_ann[PREDICTED_HBM_ANNOTATION]) == pytest.approx(
+        adm["predicted_peak_gb"], rel=1e-3)
+    assert float(pod_ann[PREDICTED_FLOPS_ANNOTATION]) > 0
+
+
+def test_malformed_declaration_degrades_never_rejects(stack):
+    from kubeflow_rm_tpu.controlplane import metrics
+    api, mgr = stack
+    before = metrics.SWALLOWED_ERRORS_TOTAL.labels(
+        module="admission")._value.get()
+    nb = spawn(api, mgr, make_notebook(
+        "typo", "user1", accelerator_type="v5litepod-8",
+        annotations={DECLARED_WORKLOAD_ANNOTATION: "{not json!!"}))
+    after = metrics.SWALLOWED_ERRORS_TOTAL.labels(
+        module="admission")._value.get()
+    assert after == before + 1
+    # degraded to chip-count-only admission: no verdict, pod renders
+    assert deep_get(nb, "status", "admission") is None
+    pods = api.list("Pod", "user1")
+    assert len(pods) == 1
+    assert deep_get(pods[0], "status", "phase") == "Running"
+    evs = [e for e in api.events_for(nb)
+           if e["reason"] == "DeclaredWorkloadUnparseable"]
+    assert evs and evs[0]["type"] == "Warning"
+    assert "chip count only" in evs[0]["message"]
+
+
+def test_removing_declaration_clears_stale_rejection(stack):
+    api, mgr = stack
+    nb = spawn(api, mgr, make_notebook(
+        "clear", "user1", accelerator_type="v5litepod-8",
+        annotations={DECLARED_WORKLOAD_ANNOTATION:
+                     json.dumps(OOM_DECL)}))
+    assert nb["status"]["admission"]["verdict"] == "rejected"
+    del nb["metadata"]["annotations"][DECLARED_WORKLOAD_ANNOTATION]
+    api.update(nb)
+    mgr.run_until_idle()
+    nb = api.get("Notebook", "clear", "user1")
+    assert deep_get(nb, "status", "admission") is None
+    assert len(api.list("Pod", "user1")) == 1
+
+
+# ---- the scheduler: predicted HBM as the second packing axis ---------
+
+def _node(name: str, chips: int, hbm_gib: float = 0.0) -> dict:
+    alloc = {GOOGLE_TPU_RESOURCE: str(chips)}
+    if hbm_gib:
+        alloc[GOOGLE_TPU_HBM_RESOURCE] = str(hbm_gib)
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": name, "labels": {}},
+            "status": {"allocatable": alloc, "capacity": dict(alloc)}}
+
+
+def _pod(name: str, chips: int, hbm_gb: float | None = None,
+         flops: float | None = None, ns: str = "d") -> dict:
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": ns,
+                        "annotations": {}},
+           "spec": {"containers": [{"name": "c", "resources": {
+               "requests": {GOOGLE_TPU_RESOURCE: str(chips)}}}]}}
+    if hbm_gb is not None:
+        pod["metadata"]["annotations"][PREDICTED_HBM_ANNOTATION] = \
+            str(hbm_gb)
+    if flops is not None:
+        pod["metadata"]["annotations"][PREDICTED_FLOPS_ANNOTATION] = \
+            str(flops)
+    return pod
+
+
+@pytest.fixture
+def hbm_packing_on():
+    scheduler.set_hbm_packing(True)
+    yield
+    scheduler.set_hbm_packing(False)
+
+
+def _cache(*nodes) -> tuple[APIServer, SchedulerCache]:
+    api = APIServer()
+    api.ensure_namespace("d")
+    for n in nodes:
+        api.create(n)
+    cache = SchedulerCache(api)
+    cache.rebuild(api)
+    return api, cache
+
+
+def test_hbm_axis_refuses_before_chips_do(hbm_packing_on):
+    # 8 chips but only 100 GiB: two 48-GB (44.7 GiB) declarations fit,
+    # the third is refused on HBM with 4 chips still free
+    _, cache = _cache(_node("n0", 8, hbm_gib=100.0))
+    assert cache.gang_bind([_pod("a", 2, hbm_gb=48.0)],
+                           allow_virtual=False)
+    assert cache.gang_bind([_pod("b", 2, hbm_gb=48.0)],
+                           allow_virtual=False)
+    assert cache.gang_bind([_pod("c", 2, hbm_gb=48.0)],
+                           allow_virtual=False) is None
+    used, cap = cache.hbm_by_node()["n0"]
+    assert used <= cap + 1e-3
+    assert cache.node_used("n0") == 4.0
+
+
+def test_hbm_arm_admits_mix_chip_arm_refuses():
+    """The ADMIT_r01 acceptance shape in miniature: declared-light
+    pods pack past the physical chip count under --hbm-packing (HBM is
+    the real limit), while the chip-count arm refuses the same mix."""
+    def run() -> int:
+        _, cache = _cache(_node("n0", 8, hbm_gib=1000.0))
+        admitted = 0
+        for i in range(5):
+            if cache.gang_bind([_pod(f"p{i}", 4, hbm_gb=10.0)],
+                               allow_virtual=False):
+                admitted += 1
+        return admitted
+
+    assert run() == 2  # chip-count arm: 8 chips / 4 = 2
+    scheduler.set_hbm_packing(True)
+    try:
+        assert run() == 5  # HBM arm: 9.3 GiB × 5 ≪ 1000 GiB
+    finally:
+        scheduler.set_hbm_packing(False)
+
+
+def test_hbm_never_overcommitted_under_concurrent_gang_binds(
+        hbm_packing_on):
+    _, cache = _cache(_node("n0", 8, hbm_gib=100.0),
+                      _node("n1", 8, hbm_gib=100.0))
+    racers = 12  # 12 × 44.7 GiB over 2 × 100 GiB nodes → 4 fit
+    barrier = threading.Barrier(racers)
+    plans: list = [None] * racers
+
+    def bind(i: int):
+        barrier.wait()
+        plans[i] = cache.gang_bind([_pod(f"r{i}", 2, hbm_gb=48.0)],
+                                   allow_virtual=False)
+
+    threads = [threading.Thread(target=bind, args=(i,))
+               for i in range(racers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(p is not None for p in plans) == 4
+    for name, (used, cap) in cache.hbm_by_node().items():
+        assert used <= cap + 1e-3, f"{name} HBM overcommitted"
+
+
+def test_undeclared_pod_charges_full_chip_share(hbm_packing_on):
+    # an undeclared 4-chip pod on an 8-chip/100-GiB node implicitly
+    # owns half the HBM — a declared pod can't pack past what's left
+    _, cache = _cache(_node("n0", 8, hbm_gib=100.0))
+    assert cache.gang_bind([_pod("plain", 4)], allow_virtual=False)
+    used, _ = cache.hbm_by_node()["n0"]
+    assert used == pytest.approx(50.0)
+    assert cache.gang_bind([_pod("big", 2, hbm_gb=60.0)],
+                           allow_virtual=False) is None  # 55.9 > 50 left
+    assert cache.gang_bind([_pod("small", 2, hbm_gb=40.0)],
+                           allow_virtual=False)  # 37.3 GiB fits
+
+
+def test_hbm_and_flops_released_on_release_and_forget(hbm_packing_on):
+    _, cache = _cache(_node("n0", 8, hbm_gib=100.0))
+    key = ("d", "p0")
+    pod = _pod("p0", 2, hbm_gb=48.0, flops=1e12)
+
+    assert cache.gang_bind([pod], allow_virtual=False)
+    assert cache.hbm_by_node()["n0"][0] > 0
+    cache.forget(key)   # bind write failed → nothing stays charged
+    assert cache.hbm_by_node()["n0"][0] == 0.0
+    assert cache.node_used("n0") == 0.0
+
+    # suspend/preempt/failover all funnel through release(): the new
+    # axes free with the chips
+    assert cache.gang_bind([pod], allow_virtual=False)
+    cache.confirm(key, 7)
+    assert cache.hbm_by_node()["n0"][0] > 0
+    cache.release(key)
+    assert cache.hbm_by_node()["n0"][0] == 0.0
+    assert cache.node_used("n0") == 0.0
+
+
+def test_flops_tiebreak_spreads_declared_trainers(hbm_packing_on):
+    # engineer two EQUALLY-fragmented nodes where only the predicted
+    # FLOPs differ: the next declared trainer lands on the
+    # computationally cooler one instead of stacking behind the hot one
+    _, cache = _cache(_node("n0", 8, hbm_gib=100.0),
+                      _node("n1", 8, hbm_gib=100.0))
+    p0 = cache.gang_bind([_pod("hot", 2, hbm_gb=10.0, flops=5e12)],
+                         allow_virtual=False)
+    assert p0[("d", "hot")] == "n0"  # name tiebreak on a fresh fleet
+    p1 = cache.gang_bind([_pod("filler", 2)], allow_virtual=False,
+                         exclude_nodes={"n0"})
+    assert p1[("d", "filler")] == "n1"
+    # both nodes now 6 chips free; n0 carries 5e12 predicted FLOPs
+    p2 = cache.gang_bind([_pod("next", 2, hbm_gb=10.0, flops=5e12)],
+                         allow_virtual=False)
+    assert p2[("d", "next")] == "n1"

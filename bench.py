@@ -287,6 +287,12 @@ def _offload_agreement(native):
                      "native_on_chip_peak_gb": n["on_chip_peak_gb"],
                      "delta_pct": round(delta, 1),
                      "verdicts_match": p["fit"] == n["fit"]})
+    # bridge the drift into the TSDB-sampled registry: sustained >20%
+    # trips the warn-only declared-hbm-drift SLO at /api/alerts
+    from kubeflow_rm_tpu.controlplane.webhook.admission_pricer import (
+        record_declared_drift,
+    )
+    record_declared_drift(rows)
     return rows
 
 

@@ -84,6 +84,10 @@ LOCK_HIERARCHY: dict[str, int] = {
     "readiness.registry": 410,
     "readiness.key": 420,           # per-notebook condvar family
     "jupyter.hub_registry": 430,
+    # guards only the lease table; snapshots are taken under it and
+    # every external call (gang_bind, fleet drain/remove) runs after
+    # release — but it logically precedes routing into the fleet
+    "harvest.controller": 433,
     "serving.fleet": 435,           # routes INTO gateways (440): uphill
     "serving.gateway": 440,
     # the global chain store is reached from the fleet routing path

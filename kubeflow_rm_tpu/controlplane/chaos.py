@@ -71,6 +71,10 @@ FAULT_KINDS = (
     "shard_split",       # SIGKILL the donor mid-split (tail-replay
                          # must recover from the respawned donor's WAL
                          # with zero loss)
+    "replica_kill",      # serving-fleet replica SIGKILLed mid-decode
+                         # (in-flight requests migrate bit-exact; a
+                         # HARVESTED replica's chips must still return
+                         # to the notebook pool clean)
 )
 
 
@@ -348,6 +352,26 @@ def split_kill_fault(site: str) -> bool:
         return False
     plan._record("shard_split", site, defer_flight=False)
     return True
+
+
+def replica_kill_victim(names: list[str]) -> str | None:
+    """Serving-fleet choke point: one opportunity per harness tick;
+    returns the replica to SIGKILL (``fleet.kill`` — queued AND
+    mid-decode requests migrate to surviving replicas via the
+    store-held prefixes). The harvest chaos arm feeds HARVESTED
+    replica names here: the assertion downstream is that the donor
+    notebook's chips come back clean even when the borrower dies
+    without a drain."""
+    plan = _plan
+    if plan is None or not names:
+        return None
+    spec = plan._draw("replica_kill", "serving_fleet")
+    if spec is None:
+        return None
+    plan._record("replica_kill", "serving_fleet", defer_flight=False)
+    with plan._lock:
+        n = plan.counts["replica_kill"]
+    return sorted(names)[n % len(names)]
 
 
 def shard_kill_victim(names: list[str]) -> str | None:

@@ -98,6 +98,9 @@ class WorkQueue:
         # (due_time, from_backoff, item); from_backoff entries may be
         # promoted early by a deterministic drain
         self._delayed: list[tuple[float, bool, Hashable]] = []
+        # namespaces with a live workqueue_namespace_depth series —
+        # a namespace that drains must be zeroed, not just dropped
+        self._ns_exported: set[str] = set()
 
     # ---- adds --------------------------------------------------------
     def add(self, item: Hashable) -> None:
@@ -218,3 +221,18 @@ class WorkQueue:
     def _set_depth(self) -> None:
         metrics.WORKQUEUE_DEPTH.labels(name=self.name).set(
             len(self._pending))
+        # per-namespace breakdown: the shard autoscaler's carve-off
+        # needs to see WHICH namespace a deep queue belongs to, not
+        # just that the queue is deep
+        by_ns: dict[str, int] = {}
+        for item in self._pending:
+            ns = getattr(item, "namespace", None)
+            if ns:
+                by_ns[ns] = by_ns.get(ns, 0) + 1
+        for ns in self._ns_exported - set(by_ns):
+            metrics.WORKQUEUE_NAMESPACE_DEPTH.labels(
+                name=self.name, namespace=ns).set(0)
+        for ns, n in by_ns.items():
+            metrics.WORKQUEUE_NAMESPACE_DEPTH.labels(
+                name=self.name, namespace=ns).set(n)
+        self._ns_exported = set(by_ns)

@@ -78,6 +78,15 @@ WORKQUEUE_DEPTH = Gauge(
     ["name"],
     registry=REGISTRY,
 )
+WORKQUEUE_NAMESPACE_DEPTH = Gauge(
+    "workqueue_namespace_depth",
+    "Pending work-queue items broken down by the namespace they "
+    "reconcile — the hot-namespace signal the shard autoscaler's "
+    "carve-off reads; drained namespaces are zeroed, not dropped, so "
+    "federated last-value sums never hold stale depth",
+    ["name", "namespace"],
+    registry=REGISTRY,
+)
 WORKQUEUE_ADDS_TOTAL = Counter(
     "workqueue_adds_total",
     "Total items added to a controller's work queue (pre-dedup)",
@@ -598,6 +607,46 @@ NOTEBOOK_MIGRATION_TOTAL = Counter(
     "Live migrations (checkpoint -> drain -> re-bind on different "
     "nodes) by trigger (api | fragmentation)",
     ["trigger"],
+    registry=REGISTRY,
+)
+
+# ---- chip harvesting (r20): serving on idle notebook chips ----------
+HARVESTED_CHIPS = Gauge(
+    "harvested_chips",
+    "TPU chips currently on loan to the serving fleet under harvest "
+    "leases (charges marked harvested=true in the scheduler cache) — "
+    "capacity a notebook resume reclaims instantly",
+    registry=REGISTRY,
+)
+HARVEST_GRANTS_TOTAL = Counter(
+    "harvest_grants_total",
+    "Harvest leases granted: an idle/suspended notebook's slice "
+    "checkpointed, drained, and re-bound as a serving replica gang",
+    registry=REGISTRY,
+)
+HARVEST_RECLAIMS_TOTAL = Counter(
+    "harvest_reclaims_total",
+    "Harvest leases reclaimed, by trigger (resume | preempt | "
+    "idle_giveback | chaos) — resume means a notebook demanded its "
+    "chips back and outranked serving",
+    ["trigger"],
+    registry=REGISTRY,
+)
+HARVEST_RECLAIM_SECONDS = Histogram(
+    "harvest_reclaim_seconds",
+    "Demand-resume reclaim latency: resume request observed to the "
+    "harvested replica drained and its lease released — must fit "
+    "inside the r15 failover SLO (notebook_failover_seconds envelope)",
+    buckets=(0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0),
+    registry=REGISTRY,
+)
+DECLARED_HBM_DRIFT_RATIO = Gauge(
+    "declared_hbm_drift_ratio",
+    "Worst relative divergence between a workload's observed on-chip "
+    "HBM peak and its webhook-priced declared peak "
+    "(|observed - declared| / declared, max over tracked workloads) — "
+    "sustained > 0.2 trips the warn-only declared-hbm-drift SLO",
     registry=REGISTRY,
 )
 

@@ -224,6 +224,17 @@ def default_slos() -> list[SLO]:
                         "or traffic lost all prefix overlap) — decode "
                         "replicas are back to paying full prefill "
                         "after every rebalance or death"),
+        GaugeSLO(
+            name="declared-hbm-drift",
+            metric="declared_hbm_drift_ratio",
+            windows=warn_only, threshold=0.2,
+            description="warn-only: observed on-chip HBM peak drifts "
+                        ">20% from the declared-workload prediction "
+                        "for a sustained window — the declaration the "
+                        "admission pricer charged against no longer "
+                        "describes the job (model update changed the "
+                        "footprint); repack before the next bind, do "
+                        "not page"),
         TenantRateSLO(
             name="jit-recompile-storm", metric="jit_recompiles_total",
             windows=warn_only, allowed_per_s=1.0 / 30.0,

@@ -190,7 +190,8 @@ class _Node:
 
 
 class _Entry:
-    __slots__ = ("node", "chips", "cpu", "hbm", "flops", "rv")
+    __slots__ = ("node", "chips", "cpu", "hbm", "flops", "rv",
+                 "harvested")
 
     def __init__(self, node: str, chips: float, cpu: float, rv: float,
                  hbm: float = 0.0, flops: float = 0.0):
@@ -200,6 +201,10 @@ class _Entry:
         self.hbm = hbm                  # GiB actually charged
         self.flops = flops
         self.rv = rv
+        # harvest lease (r20): a harvested charge is serving work
+        # squatting on idle notebook chips — instantly reclaimable,
+        # it NEVER blocks a notebook bind the way a real charge does
+        self.harvested = False
 
 
 class SchedulerCache:
@@ -223,6 +228,11 @@ class SchedulerCache:
         self._assumed = 0
         self._backend = (weakref.ref(backend)
                          if backend is not None else None)
+        #: the ChipHarvestController's synchronous give-back: called
+        #: with an optional node-name filter, drains the harvest
+        #: replicas charged there and releases their leases, returning
+        #: the chips freed. None when no harvester is attached.
+        self.harvest_reclaimer = None
 
     # -- the informer feed (one dispatch thread per backend) -----------
     def observe(self, etype: str, obj: dict, old: dict | None = None) -> None:
@@ -418,7 +428,8 @@ class SchedulerCache:
     # -- assume / confirm / forget (the bind protocol) -----------------
     def gang_bind(self, pods: list[dict], *,
                   allow_virtual: bool,
-                  exclude_nodes: set[str] | None = None
+                  exclude_nodes: set[str] | None = None,
+                  prefer_whole_nodes: bool = False
                   ) -> dict[tuple, str] | None:
         """Place a whole gang all-or-nothing. Returns ``{(ns, name):
         node_name}`` with every placement *assumed* in the cache, or
@@ -426,7 +437,11 @@ class SchedulerCache:
         must ``confirm`` each bind after its apiserver write lands, or
         ``forget`` it on failure. ``exclude_nodes`` bars named nodes
         from the plan — live migration's re-bind passes the nodes the
-        slice just drained off so it genuinely moves."""
+        slice just drained off so it genuinely moves.
+        ``prefer_whole_nodes`` inverts the fragmentation tiebreak:
+        harvest gangs take ENTIRELY free nodes first (the slices their
+        notebooks just vacated), so a lease returns a whole slice and
+        never pins a remainder under a half-used node."""
         from kubeflow_rm_tpu.controlplane import metrics, tracing
         self._ensure_fresh()
         with tracing.start_span_if_active(
@@ -434,7 +449,8 @@ class SchedulerCache:
                                     "allow_virtual": allow_virtual}) as sp:
             t0 = time.perf_counter()
             plan = self._try_gang(pods, allow_virtual,
-                                  exclude_nodes=exclude_nodes)
+                                  exclude_nodes=exclude_nodes,
+                                  prefer_whole_nodes=prefer_whole_nodes)
             result = "bound" if plan is not None else "unschedulable"
             metrics.SCHEDULE_LATENCY_SECONDS.labels(
                 result=result).observe(time.perf_counter() - t0)
@@ -442,7 +458,8 @@ class SchedulerCache:
         return plan
 
     def _try_gang(self, pods: list[dict], allow_virtual: bool,
-                  exclude_nodes: set[str] | None = None
+                  exclude_nodes: set[str] | None = None,
+                  prefer_whole_nodes: bool = False
                   ) -> dict[tuple, str] | None:
         # pick first (selection without locks), then verify-and-commit
         # under the chosen nodes' locks; capacity taken by a concurrent
@@ -468,8 +485,16 @@ class SchedulerCache:
             # equally-fragmented nodes, land on the computationally
             # coolest one — declared heavy trainers spread out instead
             # of stacking behind one oversubscribed systolic array
-            nodes.sort(key=lambda n: (free0[n.name], flops0[n.name],
-                                      n.name))
+            if prefer_whole_nodes:
+                # harvest gangs: wholly-free nodes first (free ==
+                # capacity), then the usual least-free-first remainder
+                nodes.sort(key=lambda n: (
+                    0 if (n.capacity > 0
+                          and free0[n.name] >= n.capacity) else 1,
+                    free0[n.name], flops0[n.name], n.name))
+            else:
+                nodes.sort(key=lambda n: (free0[n.name],
+                                          flops0[n.name], n.name))
             plan: dict[tuple, str] = {}
             # per-node tentative [chips, cpu, hbm, relaxed] charged by
             # THIS gang — heterogeneous pods share the map so a learner
@@ -661,6 +686,59 @@ class SchedulerCache:
                 metrics.SCHEDULER_ASSUMED_PODS.set(self._assumed)
         self._adjust((e.node, e.chips, e.cpu, e.hbm, e.flops), None)
 
+    # -- harvest leases (r20) ------------------------------------------
+    def mark_harvested(self, key: tuple) -> None:
+        """Tag a charge as a harvest lease: serving work on idle
+        notebook chips, instantly reclaimable by ANY notebook bind.
+        Harvest charges stay ``_ASSUMED`` forever (there is no
+        apiserver pod behind them), which is exactly what lets them
+        survive a relist rebuild."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        with self._plock:
+            e = self._pods.get(key)
+            if e is not None:
+                e.harvested = True
+            metrics.HARVESTED_CHIPS.set(sum(
+                x.chips for x in self._pods.values() if x.harvested))
+
+    def harvested_entries(self) -> dict[tuple, tuple[str, float]]:
+        """``{(ns, name): (node, chips)}`` for every live harvest
+        lease."""
+        with self._plock:
+            return {k: (e.node, e.chips)
+                    for k, e in self._pods.items() if e.harvested}
+
+    def harvested_chips(self) -> float:
+        with self._plock:
+            return sum(e.chips for e in self._pods.values()
+                       if e.harvested)
+
+    def release_harvested(self, key: tuple) -> None:
+        """Release one harvest lease (give-back)."""
+        from kubeflow_rm_tpu.controlplane import metrics
+        self.release(key)
+        with self._plock:
+            metrics.HARVESTED_CHIPS.set(sum(
+                e.chips for e in self._pods.values() if e.harvested))
+
+    def reclaim_harvested(self, nodes: set[str] | None = None, *,
+                          trigger: str = "preempt") -> float:
+        """Synchronous give-back: ask the attached harvester to drain
+        and release its leases (optionally only those charged on
+        ``nodes``). Returns chips freed; 0.0 when no harvester is
+        attached or nothing was harvested there. Notebook resume and
+        preemption call this FIRST — notebook demand always outranks
+        harvested serving."""
+        fn = self.harvest_reclaimer
+        if fn is None:
+            return 0.0
+        try:
+            return float(fn(nodes, trigger) or 0.0)
+        except Exception:
+            from kubeflow_rm_tpu.controlplane import metrics
+            metrics.swallowed("scheduler", "harvest reclaim")
+            return 0.0
+
     # -- read-side helpers ---------------------------------------------
     def total_used(self) -> float:
         """Chips currently charged across the fleet — O(nodes), serves
@@ -725,6 +803,8 @@ class SchedulerCache:
         from kubeflow_rm_tpu.controlplane import metrics
         with self._plock:
             pods, assumed = len(self._pods), self._assumed
+            harvested = sum(e.chips for e in self._pods.values()
+                            if e.harvested)
         with self._nlock:
             nodes = list(self._nodes.values())
         free: list[float] = []
@@ -746,10 +826,12 @@ class SchedulerCache:
         metrics.SCHEDULER_LARGEST_FREE_GANG.set(largest)
         metrics.SCHEDULER_FRAGMENTATION.set(frag)
         metrics.SCHEDULER_FREE_HBM_GIB.set(free_hbm)
+        metrics.HARVESTED_CHIPS.set(harvested)
         return {"nodes": len(nodes), "pods": pods, "assumed": assumed,
                 "stale": self._stale, "free_chips": free_chips,
                 "free_cpu": free_cpu, "free_hbm_gib": free_hbm,
-                "largest_free_gang": largest, "fragmentation": frag}
+                "largest_free_gang": largest, "fragmentation": frag,
+                "harvested_chips": harvested}
 
 
 # ---- per-backend cache registry + the legacy A/B switch --------------

@@ -467,14 +467,81 @@ class ServingFleet:
         for s, n in counts.items():
             cp_metrics.SERVING_FLEET_REPLICAS.labels(s).set(n)
 
+    def _rebuild_ring_locked(self) -> None:
+        ready = [m for m in self.gateways
+                 if self._state[m] == READY]
+        self._ring = (HashRing(ready, vnodes=self._vnodes)
+                      if ready else None)
+        self._publish_states()
+
     def _set_state(self, name: str, state: str) -> None:
         with self._lock:
             self._state[name] = state
-            ready = [m for m in self.gateways
-                     if self._state[m] == READY]
-            self._ring = (HashRing(ready, vnodes=self._vnodes)
-                          if ready else None)
-            self._publish_states()
+            self._rebuild_ring_locked()
+
+    def add_replica(self, name: str, gateway: ServingGateway,
+                    role: str | None = None) -> None:
+        """Grow the fleet live: ``name`` joins the ring READY and new
+        traffic starts landing on it immediately (consistent hashing
+        moves only the keys that must move). On a disaggregated fleet
+        ``role`` is required; the global store makes every previously
+        published prefix adoptable by the newcomer at once."""
+        with self._lock:
+            if name in self.gateways:
+                raise ValueError(f"replica {name!r} already in fleet")
+            if self.roles is not None:
+                if role not in ROLES:
+                    raise ValueError(
+                        f"disaggregated fleet: role must be one of "
+                        f"{'|'.join(ROLES)}, got {role!r}")
+                self.roles[name] = role
+            elif role is not None:
+                raise ValueError("role given but fleet is not "
+                                 "disaggregated (no roles=...)")
+            self.gateways[name] = gateway
+            self._state[name] = READY
+            self._rebuild_ring_locked()
+        if self.store is not None and getattr(gateway.engine, "paged",
+                                              False):
+            gateway.engine.pool.on_evict = self._promote_hook(
+                gateway.engine)
+        self._publish_tiers()
+
+    def remove_replica(self, name: str,
+                       *, grace_s: float = 0.0) -> ServingGateway:
+        """Shrink the fleet live: drain ``name`` (out of the ring,
+        queued work migrates), optionally let active slots finish for
+        ``grace_s``, then close it — remaining in-flight requests take
+        the r13 kill-migration path and complete bit-identically
+        elsewhere. Prefixes the replica promoted/published survive in
+        the global store. Returns the detached gateway."""
+        with self._lock:
+            if name not in self.gateways:
+                raise KeyError(f"no replica {name!r}")
+            if len(self.gateways) == 1:
+                raise ValueError("cannot remove the last replica")
+            if (self.roles is not None
+                    and self.roles.get(name) == "decode"
+                    and sum(1 for m, r in self.roles.items()
+                            if r == "decode" and m != name) == 0):
+                raise ValueError("cannot remove the last decode "
+                                 "replica")
+        self.drain(name)
+        gw = self.gateways[name]
+        if grace_s > 0:
+            deadline = time.monotonic() + grace_s
+            while (gw.engine.active_slots
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+        gw.close()
+        with self._lock:
+            self.gateways.pop(name, None)
+            self._state.pop(name, None)
+            if self.roles is not None:
+                self.roles.pop(name, None)
+            self._rebuild_ring_locked()
+        self._publish_tiers()
+        return gw
 
     def drain(self, name: str) -> None:
         """Pull ``name`` out of rotation: ring drops it, its healthz
@@ -545,11 +612,15 @@ class ServingFleet:
             ring = (self._ring if not exclude and self._ring is not None
                     else HashRing(ready, vnodes=self._vnodes))
             owner = ring.shard_for(key)
-        depth = self.gateways[owner].engine.queue_depth
+            # snapshot the gateway objects under the lock: a concurrent
+            # remove_replica may pop names from self.gateways the
+            # moment we release it
+            gws = {m: self.gateways[m] for m in ready}
+        depth = gws[owner].engine.queue_depth
         if depth >= self.spill_depth and len(ready) > 1:
             shallowest = min(
-                ready, key=lambda m: self.gateways[m].engine.queue_depth)
-            if (self.gateways[shallowest].engine.queue_depth < depth
+                ready, key=lambda m: gws[m].engine.queue_depth)
+            if (gws[shallowest].engine.queue_depth < depth
                     and shallowest != owner):
                 self.spills += 1
                 return shallowest
@@ -564,10 +635,10 @@ class ServingFleet:
                      if self._state[m] == READY
                      and self.roles[m] == "decode"
                      and m not in (exclude or ())]
+            gws = {m: self.gateways[m] for m in ready}
         if not ready:
             raise NoReadyReplica("no ready decode replica")
-        return min(ready,
-                   key=lambda m: self.gateways[m].engine.queue_depth)
+        return min(ready, key=lambda m: gws[m].engine.queue_depth)
 
     def _route_prefill(self) -> str | None:
         """Shallowest-queue READY prefill replica, or None when the
@@ -577,10 +648,10 @@ class ServingFleet:
             ready = [m for m in sorted(self.gateways)
                      if self._state[m] == READY
                      and self.roles[m] == "prefill"]
+            gws = {m: self.gateways[m] for m in ready}
         if not ready:
             return None
-        return min(ready,
-                   key=lambda m: self.gateways[m].engine.queue_depth)
+        return min(ready, key=lambda m: gws[m].engine.queue_depth)
 
     def _stage_prefix(self, gw: ServingGateway,
                       prompt: list[int]) -> dict | None:
@@ -605,11 +676,12 @@ class ServingFleet:
             gw.adopt_chain(entry)   # partial: seat the covered head
             return None
         pf = self._route_prefill()
-        if pf is None:
+        pf_gw = self.gateways.get(pf) if pf is not None else None
+        if pf_gw is None:
             return None     # prefill tier down: decode-local prefill
         t0 = time.monotonic()
         try:
-            chain = self.gateways[pf].prefill_chain(prompt)
+            chain = pf_gw.prefill_chain(prompt)
         except ValueError:
             return None     # prompt outside the prefill slot shape
         if chain is None:
@@ -658,7 +730,13 @@ class ServingFleet:
                         self.route(full, session, exclude=tried or None))
             except NoReadyReplica:
                 return None, {"replicas": path, "reason": "no_replica"}
-            gw = self.gateways[name]
+            gw = self.gateways.get(name)
+            if gw is None:
+                # lost the race with remove_replica: the topology was
+                # rebuilt after we routed. Re-resolve from the CURRENT
+                # ring — never submit to a replica being removed.
+                tried.add(name)
+                continue
             chain = None
             if (disagg and self.store is not None and not speculative
                     and getattr(gw.engine, "paged", False)):

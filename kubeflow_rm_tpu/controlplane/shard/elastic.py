@@ -169,13 +169,27 @@ class ElasticShardManager:
             "members": list(self.router.ring.members), **detail})
 
     # ---- public verbs ------------------------------------------------
-    def split(self, name: str | None = None) -> str:
+    def split(self, name: str | None = None, *,
+              weight: int | None = None,
+              dedicate: str | None = None) -> str:
         """Admit one new shard: spawn it empty, hand it the range the
-        new ring assigns it, flip. Returns the new shard's name."""
+        new ring assigns it, flip. Returns the new shard's name.
+
+        ``weight`` caps the newcomer's vnode count (``with_weight``);
+        ``dedicate`` pins one partition key to it (``with_pin``).
+        Together they make a **carve-off**: weight=1 means the new
+        shard claims almost none of the hash range, so the pinned
+        namespace is effectively all it serves — a dedicated shard for
+        a hot tenant instead of an even rebalance. One ring
+        derivation, one handoff, one flip."""
         with self._lock:
             t0 = time.monotonic()
             new_name = self.runner.add_shard(name)
             new_ring = self.router.ring.with_member(new_name)
+            if weight is not None:
+                new_ring = new_ring.with_weight(new_name, weight)
+            if dedicate is not None:
+                new_ring = new_ring.with_pin(dedicate, new_name)
             stats = self._handoff(new_ring, op="split",
                                   fresh=new_name)
             metrics.SHARD_SPLITS_TOTAL.inc()
@@ -184,6 +198,8 @@ class ElasticShardManager:
             if self.observer is not None:
                 self.observer.tsdb.add_scrape(
                     new_name, self.runner.urls[new_name])
+            if dedicate is not None:
+                stats = dict(stats, dedicate=dedicate)
             self._event("split", shard=new_name, **stats)
             log.info("split: admitted %s (%s)", new_name, stats)
             return new_name
@@ -611,12 +627,25 @@ class ShardAutoscaler:
     ``sustain`` consecutive pressure ticks split (up to ``max_shards``,
     the 2→6 of the diurnal story); ``sustain`` idle ticks merge (down
     to ``min_shards``). ``cooldown_s`` after every action stops
-    thrash while the fleet re-settles."""
+    thrash while the fleet re-settles.
+
+    **Hot-namespace carve-off**: when ONE namespace accounts for at
+    least ``carve_fraction`` of a deep shard's queue (the per-namespace
+    ``workqueue_namespace_depth`` series the workqueues export), an
+    even split would move random ranges while the hot tenant keeps
+    drowning whichever shard the hash gives it. Instead the autoscaler
+    carves: ``split(weight=carve_weight, dedicate=ns)`` admits a
+    near-weightless shard (vnodes=1 claims ~no hash range) and pins
+    the hot namespace to it — a dedicated shard for the noisy tenant,
+    everyone else's routing untouched. A namespace that is already
+    pinned is never re-carved; when it cools, the ordinary merge path
+    retires its shard and ``without_member`` drops the pin."""
 
     def __init__(self, elastic: ElasticShardManager, observer, *,
                  min_shards: int = 2, max_shards: int = 6,
                  split_depth: float = 8.0, merge_depth: float = 1.0,
                  sustain: int = 3, cooldown_s: float = 5.0,
+                 carve_fraction: float = 0.6, carve_weight: int = 1,
                  burn_slos: tuple = ("provision-p50", "wal-fsync",
                                      "scheduler-latency")):
         self.elastic = elastic
@@ -627,9 +656,13 @@ class ShardAutoscaler:
         self.merge_depth = float(merge_depth)
         self.sustain = int(sustain)
         self.cooldown_s = float(cooldown_s)
+        self.carve_fraction = float(carve_fraction)
+        self.carve_weight = int(carve_weight)
         self.burn_slos = tuple(burn_slos)
         self._high = 0
         self._idle = 0
+        self._hot_ns: str | None = None
+        self._hot = 0
         self._last_action = 0.0
         #: decision log for the conformance artifact
         self.decisions: list[dict] = []
@@ -653,9 +686,38 @@ class ShardAutoscaler:
             total += v or 0.0
         return total / max(len(members), 1)
 
+    def _hot_namespace(self) -> str | None:
+        """The namespace dominating one deep shard's queue, or None.
+        A namespace already pinned (previously carved, or a notebook
+        live-migration pin) is never a candidate — its shard IS its
+        dedicated shard; re-carving would thrash."""
+        tsdb = self.observer.tsdb
+        ring = self.elastic.router.ring
+        try:
+            spaces = tsdb.label_values("workqueue_namespace_depth",
+                                       "namespace")
+        except AttributeError:
+            return None  # reduced fakes without the breakdown
+        for shard in ring.members:
+            total = tsdb.latest("workqueue_depth",
+                                {"instance": shard}) or 0.0
+            if total < self.split_depth:
+                continue
+            for ns in spaces:
+                if ring.pins.get(ns) is not None \
+                        or ring.shard_for(ns) != shard:
+                    continue
+                v = tsdb.latest("workqueue_namespace_depth",
+                                {"instance": shard,
+                                 "namespace": ns}) or 0.0
+                if v / total >= self.carve_fraction:
+                    return ns
+        return None
+
     def tick(self, now: float | None = None) -> str:
         """One evaluation; returns the decision taken
-        (``split`` | ``merge`` | ``hold`` | ``cooldown``)."""
+        (``split`` | ``carve`` | ``merge`` | ``hold`` |
+        ``cooldown``)."""
         now = time.monotonic() if now is None else now
         n = len(self.elastic.router.ring)
         depth = self._mean_depth()
@@ -669,10 +731,25 @@ class ShardAutoscaler:
             self._high = 0
         else:
             self._high = self._idle = 0
+        hot = self._hot_namespace()
+        if hot is not None and hot == self._hot_ns:
+            self._hot += 1
+        else:
+            self._hot_ns = hot
+            self._hot = 1 if hot is not None else 0
         decision = "hold"
         if self._last_action and \
                 now - self._last_action < self.cooldown_s:
             decision = "cooldown"
+        elif hot is not None and self._hot >= self.sustain \
+                and n < self.max_shards:
+            # carve beats even split: the pressure is one tenant, so
+            # give THAT tenant a dedicated (near-weightless) shard
+            self.elastic.split(weight=self.carve_weight, dedicate=hot)
+            self._hot_ns, self._hot = None, 0
+            self._high = 0
+            self._last_action = time.monotonic()
+            decision = "carve"
         elif self._high >= self.sustain and n < self.max_shards:
             self.elastic.split()
             self._high = 0
@@ -688,5 +765,5 @@ class ShardAutoscaler:
         self.decisions.append({
             "t": round(now, 3), "decision": decision, "shards": n,
             "mean_depth": round(depth, 2), "burning": burning,
-            "high": self._high, "idle": self._idle})
+            "high": self._high, "idle": self._idle, "hot": hot})
         return decision

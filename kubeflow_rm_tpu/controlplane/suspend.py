@@ -1055,6 +1055,15 @@ def try_preempt(api: APIServer, sts: dict, unbound: list[dict],
             "oversubscribe_off" if not oversubscribe() else "legacy_scan",
             sts)
         return None
+    # harvest leases first (r20): serving work on borrowed notebook
+    # chips is instantly reclaimable by ANY gang — no priority check,
+    # no victim simulation. A resuming notebook's failed re-bind lands
+    # here, which is exactly the "notebook resume outranks serving"
+    # contract.
+    plan = _try_harvest_reclaim(api, sts, unbound, sched,
+                                allow_virtual=allow_virtual)
+    if plan is not None:
+        return plan
     nb_name = (sts["metadata"].get("labels") or {}).get(
         nb_api.NOTEBOOK_NAME_LABEL)
     if not nb_name:
@@ -1129,6 +1138,37 @@ def try_preempt(api: APIServer, sts: dict, unbound: list[dict],
         f"suspended {len(chosen)} lower-priority slice(s) "
         f"({', '.join(name_of(v.notebook) for v in chosen)}) to admit "
         f"this {len(unbound)}-host gang")
+    return sched.gang_bind(unbound, allow_virtual=allow_virtual)
+
+
+def _try_harvest_reclaim(api: APIServer, sts: dict,
+                         unbound: list[dict],
+                         sched: "scheduler.SchedulerCache", *,
+                         allow_virtual: bool
+                         ) -> dict[tuple, str] | None:
+    """Give harvested chips back to a waiting gang. The attached
+    ChipHarvestController drains its serving replicas (in-flight
+    requests migrate bit-exactly through the fleet) and releases the
+    leases synchronously; the gang then retries its bind against the
+    freed capacity. Returns a bind plan or None."""
+    if sched.harvested_chips() <= 0:
+        return None
+    trigger = "preempt"
+    nb_name = (sts["metadata"].get("labels") or {}).get(
+        nb_api.NOTEBOOK_NAME_LABEL)
+    if nb_name:
+        owner = api.try_get(nb_api.KIND, nb_name, namespace_of(sts))
+        if owner is not None and (nb_api.RESUME_REQUESTED_ANNOTATION
+                                  in annotations_of(owner)):
+            trigger = "resume"
+    freed = sched.reclaim_harvested(trigger=trigger)
+    if freed <= 0:
+        return None
+    api.record_event(
+        sts, "Normal", "HarvestReclaimed",
+        f"reclaimed {freed:.0f} harvested chip(s) from the serving "
+        f"fleet ({trigger}) — notebook demand outranks harvested "
+        "serving")
     return sched.gang_bind(unbound, allow_virtual=allow_virtual)
 
 

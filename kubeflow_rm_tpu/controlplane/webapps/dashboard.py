@@ -134,6 +134,40 @@ def create_app(api: APIServer, *, disable_auth: bool = False,
         observer.maybe_tick()
         return observer.alerts()
 
+    @app.route("/api/harvest")
+    def get_harvest(req):
+        """The chip-harvesting picture: which notebook slices are on
+        loan to the serving fleet right now (the scheduler's lease
+        ledger — ground truth, present even when no controller is
+        attached to this process), plus the lifetime grant/reclaim
+        counters and, when a :class:`ChipHarvestController` is wired
+        up via ``app.harvest``, its live lease specs."""
+        from kubeflow_rm_tpu.controlplane import metrics, scheduler
+        sched = scheduler.cache_for(api)
+        ctl = getattr(app, "harvest", None)
+        return {
+            "harvested_chips": sched.harvested_chips(),
+            "leases": [
+                {"namespace": ns, "pod": name, "node": node,
+                 "chips": chips}
+                for (ns, name), (node, chips)
+                in sorted(sched.harvested_entries().items())
+            ],
+            "controller": ctl.leases() if ctl is not None else None,
+            "grants_total": metrics.registry_value(
+                "harvest_grants_total") or 0.0,
+            "reclaims": {
+                trigger: metrics.registry_value(
+                    "harvest_reclaims_total",
+                    {"trigger": trigger}) or 0.0
+                for trigger in ("resume", "preempt", "idle_giveback")
+            },
+            "reclaim_seconds_count": metrics.registry_value(
+                "harvest_reclaim_seconds_count") or 0.0,
+            "reclaim_seconds_sum": metrics.registry_value(
+                "harvest_reclaim_seconds_sum") or 0.0,
+        }
+
     # ---- distributed traces -----------------------------------------
     def _merged_spans():
         """This process's collector merged with every shard's

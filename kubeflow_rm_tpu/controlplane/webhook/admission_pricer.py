@@ -46,6 +46,30 @@ from kubeflow_rm_tpu.controlplane.api.meta import (
 from kubeflow_rm_tpu.controlplane.apiserver import APIServer
 
 
+def record_declared_drift(agreement) -> float:
+    """Bridge ``memplan_agreement`` rows (the native walk of the
+    shipped step vs what the pricer predicted from the declaration)
+    into the metrics registry as the worst absolute delta ratio. The
+    Observer's TSDB samples the gauge every tick and the warn-only
+    ``declared-hbm-drift`` SLO surfaces a sustained >20% divergence at
+    ``/api/alerts``. Flag-only by design: drift means the declared
+    HBM axis the scheduler packs on is lying, so the operator repacks
+    (reprices) before the next bind — nothing here pages or preempts.
+    Returns the ratio it recorded."""
+    from kubeflow_rm_tpu.controlplane import metrics
+
+    worst = 0.0
+    for row in agreement or ():
+        declared = row.get("priced_on_chip_peak_gb")
+        observed = row.get("native_on_chip_peak_gb")
+        if declared:
+            worst = max(worst, abs(observed - declared) / declared)
+        elif row.get("delta_pct") is not None:
+            worst = max(worst, abs(row["delta_pct"]) / 100.0)
+    metrics.DECLARED_HBM_DRIFT_RATIO.set(worst)
+    return worst
+
+
 def slice_topology_of(obj: dict) -> tpu_api.SliceTopology | None:
     """The slice the declared workload would run on: a Notebook's
     ``spec.tpu``, or a TPUJob's first TPU role (the learner — the role

@@ -319,3 +319,44 @@ def test_flops_tiebreak_spreads_declared_trainers(hbm_packing_on):
     p2 = cache.gang_bind([_pod("next", 2, hbm_gb=10.0, flops=5e12)],
                          allow_virtual=False)
     assert p2[("d", "next")] == "n1"
+
+
+# ---- declared-HBM drift: the repack-before-rebind flag ---------------
+
+def test_declared_hbm_drift_trips_warn_only_alert():
+    """memplan_agreement drift bridged into the TSDB surfaces the
+    warn-only declared-hbm-drift SLO at /api/alerts once the windowed
+    mean exceeds 20% — and stays warning (never critical) no matter
+    how bad the drift: it flags a repack, it does not page."""
+    from kubeflow_rm_tpu.controlplane import obs
+    from kubeflow_rm_tpu.controlplane.webhook.admission_pricer import (
+        record_declared_drift,
+    )
+
+    rows = [{"preset": "bench_2_7b", "priced_on_chip_peak_gb": 13.24,
+             "native_on_chip_peak_gb": 17.2, "delta_pct": 29.9,
+             "verdicts_match": True},
+            {"preset": "bench_7b", "delta_pct": 4.0}]  # reduced row
+    try:
+        drift = record_declared_drift(rows)
+        assert drift == pytest.approx((17.2 - 13.24) / 13.24)
+
+        o = obs.Observer(interval_s=1.0)
+        base = 50_000.0
+        for t in (0.0, 30.0, 60.0):   # sustained, not a lone spike
+            o.tick(now=base + t)
+        snap = o.alerts()
+        active = {a["slo"]: a for a in snap["active"]}
+        assert "declared-hbm-drift" in active
+        assert active["declared-hbm-drift"]["state"] == "warning"
+        assert o.engine.state_of("declared-hbm-drift") == "warning"
+        spec = next(s for s in snap["slos"]
+                    if s["name"] == "declared-hbm-drift")
+        assert spec["threshold"] == pytest.approx(0.2)
+    finally:
+        record_declared_drift([])   # zero the process-global gauge
+
+    # in-band agreement never arms the flag
+    assert record_declared_drift([{"delta_pct": 12.0}]) == \
+        pytest.approx(0.12)
+    record_declared_drift([])

@@ -184,3 +184,40 @@ def test_activities_carry_spa_key(stack):
     body = get_json(client, "/api/activities/team")
     assert body["activities"] == body["events"]
     app.metrics_history.stop()
+
+
+def test_harvest_endpoint_reports_lease_ledger(stack):
+    api, _ = stack
+    from kubeflow_rm_tpu.controlplane import scheduler
+    from kubeflow_rm_tpu.controlplane.webapps.dashboard import create_app
+    app = create_app(api, history_interval_s=0)
+    client = app.test_client(user=USER)
+    try:
+        body = get_json(client, "/api/harvest")
+        assert body["harvested_chips"] == 0.0
+        assert body["leases"] == []
+        assert body["controller"] is None  # no controller attached
+        assert set(body["reclaims"]) == {"resume", "preempt",
+                                         "idle_giveback"}
+
+        # a live lease in the scheduler ledger shows up without any
+        # controller: the ledger is ground truth, not the controller
+        sched = scheduler.cache_for(api)
+        api.ensure_namespace("serving-harvest")
+        api.create(make_tpu_node("hn0", "v5p-16"))
+        pod = make_object("v1", "Pod", "harvest-9-0",
+                          namespace="serving-harvest")
+        pod["spec"] = {"containers": [{
+            "name": "serve",
+            "resources": {"limits": {"google.com/tpu": "4"}}}]}
+        plan = sched.gang_bind([pod], allow_virtual=False)
+        assert plan == {("serving-harvest", "harvest-9-0"): "hn0"}
+        sched.mark_harvested(("serving-harvest", "harvest-9-0"))
+        body = get_json(client, "/api/harvest")
+        assert body["harvested_chips"] == 4.0
+        assert body["leases"] == [
+            {"namespace": "serving-harvest", "pod": "harvest-9-0",
+             "node": "hn0", "chips": 4.0}]
+        sched.release_harvested(("serving-harvest", "harvest-9-0"))
+    finally:
+        app.metrics_history.stop()

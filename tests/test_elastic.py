@@ -330,10 +330,19 @@ def test_handoff_into_tombstoned_range_lifts_the_stone(fleet):
 class _FakeTSDB:
     def __init__(self):
         self.depth: dict[str, float] = {}
+        #: (instance, namespace) -> per-namespace queue depth
+        self.ns_depth: dict[tuple, float] = {}
         self.scrapes: dict[str, str] = {}
 
     def latest(self, name, labels=None):
-        return self.depth.get((labels or {}).get("instance"))
+        labels = labels or {}
+        if name == "workqueue_namespace_depth":
+            return self.ns_depth.get(
+                (labels.get("instance"), labels.get("namespace")))
+        return self.depth.get(labels.get("instance"))
+
+    def label_values(self, name, key):
+        return sorted({ns for _, ns in self.ns_depth})
 
     def add_scrape(self, name, url):
         self.scrapes[name] = url
@@ -357,11 +366,17 @@ class _FakeElastic:
         self.calls: list[str] = []
         self._next = n
 
-    def split(self):
-        self.calls.append("split")
+    def split(self, name=None, *, weight=None, dedicate=None):
+        self.calls.append(
+            f"carve:{dedicate}" if dedicate else "split")
         name = f"shard-{self._next}"
         self._next += 1
-        self.router.ring = self.router.ring.with_member(name)
+        ring = self.router.ring.with_member(name)
+        if weight is not None:
+            ring = ring.with_weight(name, weight)
+        if dedicate is not None:
+            ring = ring.with_pin(dedicate, name)
+        self.router.ring = ring
         return name
 
     def merge(self):
@@ -426,6 +441,37 @@ def test_autoscaler_slo_burn_counts_as_pressure():
     for i in range(3):
         scaler2.tick(i)
     assert fake2.calls == ["merge"]  # stale burn does not pin 3 wide
+
+
+def test_autoscaler_carves_hot_namespace_onto_dedicated_shard():
+    """One tenant drowning one shard gets a CARVE, not an even split:
+    a near-weightless new shard with the hot namespace pinned to it.
+    The pin then disqualifies the namespace from ever being re-carved
+    while continued pressure falls through to the ordinary split."""
+    scaler, fake, obs = _scaler()
+    ring0 = fake.router.ring
+    home = ring0.shard_for("hotspot")
+    obs.tsdb.depth = {s: (40.0 if s == home else 2.0)
+                      for s in ring0.members}
+    obs.tsdb.ns_depth = {(home, "hotspot"): 36.0,
+                         (home, "quiet"): 4.0}
+    assert [scaler.tick(i) for i in range(3)] == \
+        ["hold", "hold", "carve"]
+    assert fake.calls == ["carve:hotspot"]
+    ring = fake.router.ring
+    carved = next(m for m in ring.members if m not in ring0.members)
+    assert ring.pins["hotspot"] == carved
+    assert ring.weight_of(carved) == 1  # ~no hash range: dedicated
+    # pressure follows the tenant onto its dedicated shard — but the
+    # pin means no second carve; sustained pressure even-splits instead
+    obs.tsdb.depth[carved] = 40.0
+    obs.tsdb.ns_depth = {(carved, "hotspot"): 40.0}
+    base = time.monotonic() + 10.0  # clear of the action cooldown
+    for i in range(6):
+        scaler.tick(base + i)
+    assert [c for c in fake.calls if c.startswith("carve")] == \
+        ["carve:hotspot"]
+    assert "split" in fake.calls
 
 
 def test_autoscaler_respects_cooldown_and_max():

@@ -225,6 +225,99 @@ def test_kill_resume_overflow_restarts_from_original_prompt(model):
         fleet.close()
 
 
+def test_submit_reroutes_when_routed_replica_is_removed(model):
+    """The remove_replica race: a request that routed to a replica an
+    instant before ``remove_replica`` rebuilt the topology must
+    re-resolve the ring AFTER the rebuild — never submit to (or crash
+    on) the replica being removed."""
+    fleet = _fleet(model, n=2)
+    try:
+        p = [5, 9, 2, 7, 1]
+        want = _solo(model, p, 6)
+        victim = fleet.route(p)
+        orig_route = fleet.route
+        removed = {}
+
+        def racing_route(*a, **kw):
+            name = orig_route(*a, **kw)
+            if not removed and name == victim:
+                # the topology rebuild lands between routing and
+                # submit — exactly the window the bug lived in
+                fleet.remove_replica(victim)
+                removed["done"] = True
+            return name
+
+        fleet.route = racing_route
+        tokens, info = fleet.submit_and_wait("t", list(p),
+                                             max_new_tokens=6)
+        assert removed, "race window never exercised"
+        assert tokens == want
+        assert victim not in info["replicas"]
+        assert victim not in fleet.gateways
+    finally:
+        fleet.close()
+
+
+def test_remove_replica_mid_flight_migrates_exactly(model):
+    """Live shrink while the victim holds in-flight work: queued and
+    active requests all migrate and complete bit-identically, and the
+    victim is gone from the fleet afterwards."""
+    fleet = _fleet(model, n=2)
+    try:
+        p = [5, 9, 2, 7, 1, 1, 3]
+        want = _solo(model, p, 24)
+        victim = fleet.route(p)
+        results = [None] * 4
+
+        def go(i):
+            results[i] = fleet.submit_and_wait("t", list(p),
+                                               max_new_tokens=24)
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(results))]
+        for t in threads:
+            t.start()
+        gw = fleet.gateways[victim]
+        deadline = time.monotonic() + 30
+        while (not gw.engine.active_slots
+               and time.monotonic() < deadline):
+            time.sleep(0.001)
+        assert gw.engine.active_slots
+        fleet.remove_replica(victim)
+        for t in threads:
+            t.join(timeout=60)
+        for r in results:
+            assert r is not None, "request hung"
+            tokens, _info = r
+            assert tokens == want
+        assert victim not in fleet.gateways
+        assert victim not in fleet.states()
+        assert len(fleet.states()) == 1
+    finally:
+        fleet.close()
+
+
+def test_add_replica_joins_ring_and_serves(model):
+    fleet = _fleet(model, n=1)
+    try:
+        with pytest.raises(ValueError):
+            fleet.remove_replica("r0")   # never below one replica
+        fleet.add_replica("r9", _gateway(model))
+        with pytest.raises(ValueError):
+            fleet.add_replica("r9", _gateway(model))  # dup name
+        assert fleet.states() == {"r0": "ready", "r9": "ready"}
+        # the newcomer takes real traffic: drain the original and the
+        # fleet keeps serving, exactly
+        fleet.drain("r0")
+        p = [5, 9, 2]
+        tokens, info = fleet.submit_and_wait("t", list(p),
+                                             max_new_tokens=4)
+        assert tokens == _solo(model, p, 4)
+        assert info["replicas"] == ["r9"]
+    finally:
+        fleet.close()
+
+
 def test_no_ready_replica_sheds(model):
     fleet = _fleet(model, n=1)
     try:
